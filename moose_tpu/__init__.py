@@ -1,0 +1,78 @@
+"""moose_tpu: a TPU-native secure multi-party computation framework.
+
+A from-scratch re-design of the capabilities of the reference Moose framework
+(compiler + runtime + Python eDSL for placement-pinned dataflow computations
+with 3-party replicated secret sharing over Z_{2^64}/Z_{2^128}) built on
+JAX/XLA: host kernels are jnp programs, the 3 parties ride a named mesh axis
+with ICI collectives, and whole computations compile to single fused XLA
+programs instead of per-op task graphs.
+"""
+
+import jax
+
+# Ring arithmetic needs 64-bit lanes; must be set before any jnp usage.
+jax.config.update("jax_enable_x64", True)
+
+from . import dtypes  # noqa: E402
+from .dtypes import (  # noqa: E402
+    bool_,
+    fixed,
+    fixed64,
+    fixed128,
+    float32,
+    float64,
+    int32,
+    int64,
+    uint32,
+    uint64,
+)
+from .computation import (  # noqa: E402
+    AdditivePlacement,
+    Computation,
+    HostPlacement,
+    Mirrored3Placement,
+    Operation,
+    ReplicatedPlacement,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "dtypes",
+    "bool_",
+    "fixed",
+    "fixed64",
+    "fixed128",
+    "float32",
+    "float64",
+    "int32",
+    "int64",
+    "uint32",
+    "uint64",
+    "AdditivePlacement",
+    "Computation",
+    "HostPlacement",
+    "Mirrored3Placement",
+    "Operation",
+    "ReplicatedPlacement",
+]
+
+
+def __getattr__(name):
+    # Lazy imports to keep `import moose_tpu` light and avoid cycles.
+    if name in ("computation", "host_placement", "replicated_placement",
+                "mirrored_placement", "Argument", "edsl"):
+        from . import edsl
+
+        if name == "edsl":
+            return edsl
+        return getattr(edsl.base, name)
+    if name in ("LocalMooseRuntime", "GrpcMooseRuntime"):
+        from . import runtime
+
+        return getattr(runtime, name)
+    if name == "predictors":
+        from . import predictors
+
+        return predictors
+    raise AttributeError(f"module 'moose_tpu' has no attribute {name!r}")

@@ -1,0 +1,547 @@
+"""Host dialect: plaintext kernels owned by a single host placement.
+
+TPU-native re-design of the reference's host dialect (``moose/src/host/``):
+every kernel is a pure function on JAX arrays so the whole dataflow graph can
+be fused by XLA.  The reference's ndarray/OpenBLAS kernels (``host/ops.rs``)
+map to jnp; ring tensors map to the limb representation in ``ring.py``;
+PRF-key/seed handling maps to JAX's counter-based threefry PRF
+(``host/prim.rs:113-133`` equivalents).
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as dt
+from ..values import (
+    HostBitTensor,
+    HostFixedTensor,
+    HostPrfKey,
+    HostRingTensor,
+    HostSeed,
+    HostShape,
+    HostString,
+    HostTensor,
+    HostUnit,
+)
+from . import ring
+
+# ---------------------------------------------------------------------------
+# Shapes, constants, identities
+# ---------------------------------------------------------------------------
+
+
+def shape(x, plc: str) -> HostShape:
+    if isinstance(x, HostRingTensor):
+        return HostShape(tuple(x.lo.shape), plc)
+    return HostShape(tuple(x.value.shape), plc)
+
+
+def constant(value, plc: str, dtype: Optional[dt.DType] = None):
+    """Materialize a constant. ``value`` may be a numpy array, scalar,
+    tuple (shape), or string."""
+    if isinstance(value, (HostTensor, HostRingTensor, HostBitTensor,
+                          HostShape, HostString)):
+        return place(value, plc)
+    if isinstance(value, str):
+        return HostString(value, plc)
+    if isinstance(value, (tuple, list)) and all(
+        isinstance(v, (int, np.integer)) for v in value
+    ):
+        if dtype is None:
+            return HostShape(tuple(int(v) for v in value), plc)
+    arr = np.asarray(value)
+    if dtype is not None and not dtype.is_fixedpoint:
+        arr = arr.astype(np.dtype(dtype.numpy_name))
+    if arr.dtype == np.bool_:
+        return HostBitTensor(jnp.asarray(arr.astype(np.uint8)), plc)
+    return HostTensor(jnp.asarray(arr), plc, dt.from_numpy(arr.dtype))
+
+
+def place(x, plc: str):
+    """Move/claim a value onto a host placement (Identity / Send+Receive
+    collapse to a placement relabel in single-program execution)."""
+    import dataclasses as _dc
+
+    return _dc.replace(x, plc=plc) if hasattr(x, "plc") else x
+
+
+def fill(shp: HostShape, value, plc: str, ty_name: str):
+    if ty_name.startswith("HostRing"):
+        width = 128 if "128" in ty_name else 64
+        lo, hi = ring.fill_like_shape(shp.value, width, int(value))
+        return HostRingTensor(lo, hi, width, plc)
+    if ty_name == "HostBitTensor":
+        return HostBitTensor(
+            jnp.full(shp.value, np.uint8(int(value) & 1), dtype=jnp.uint8), plc
+        )
+    raise NotImplementedError(f"fill for {ty_name}")
+
+
+def ones(shp: HostShape, dtype: dt.DType, plc: str) -> HostTensor:
+    return HostTensor(
+        jnp.ones(shp.value, dtype=np.dtype(dtype.numpy_name)), plc, dtype
+    )
+
+
+def zeros(shp: HostShape, dtype: dt.DType, plc: str) -> HostTensor:
+    return HostTensor(
+        jnp.zeros(shp.value, dtype=np.dtype(dtype.numpy_name)), plc, dtype
+    )
+
+
+def ring_zeros(shp: HostShape, width: int, plc: str) -> HostRingTensor:
+    lo, hi = ring.fill_like_shape(shp.value, width, 0)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+# ---------------------------------------------------------------------------
+# PRF keys & seeds (reference host/prim.rs)
+# ---------------------------------------------------------------------------
+
+
+def random_sync_key() -> bytes:
+    """Trace-time random nonce identifying one seed derivation
+    (reference SyncKey::random())."""
+    return secrets.token_bytes(16)
+
+
+def key_gen(plc: str, key_words) -> HostPrfKey:
+    """Create a PRF key from session-provided entropy words (uint32[4])."""
+    return HostPrfKey(jnp.asarray(key_words, dtype=jnp.uint32), plc)
+
+
+def derive_seed(key: HostPrfKey, sync_key: bytes, plc: str) -> HostSeed:
+    """Derive a 128-bit seed from a PRF key and a static nonce
+    (reference: blake3 keyed hash, host/prim.rs:123; here threefry)."""
+    words = np.frombuffer(sync_key[:16].ljust(16, b"\0"), dtype=np.uint32)
+    k = ring._key_from_seed(key.value)
+    for w in words:
+        k = jax.random.fold_in(k, np.uint32(w))
+    return HostSeed(jax.random.bits(k, (4,), dtype=jnp.uint32), plc)
+
+
+def sample_uniform_seeded(
+    shp: HostShape, seed: HostSeed, width: int, plc: str
+) -> HostRingTensor:
+    lo, hi = ring.sample_uniform_seeded(shp.value, seed.value, width)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+def sample_bits_seeded(
+    shp: HostShape, seed: HostSeed, width: int, plc: str
+) -> HostRingTensor:
+    lo, hi = ring.sample_bits_seeded(shp.value, seed.value, width)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+def sample_bit_tensor_seeded(shp: HostShape, seed: HostSeed, plc: str) -> HostBitTensor:
+    key = ring._key_from_seed(seed.value)
+    bits = jax.random.bits(key, tuple(shp.value), dtype=jnp.uint8) & jnp.uint8(1)
+    return HostBitTensor(bits, plc)
+
+
+# ---------------------------------------------------------------------------
+# Ring tensor kernels
+# ---------------------------------------------------------------------------
+
+
+def _ring2(op):
+    def kernel(x: HostRingTensor, y: HostRingTensor, plc: str) -> HostRingTensor:
+        lo, hi = op(x.lo, x.hi, y.lo, y.hi)
+        return HostRingTensor(lo, hi, x.width, plc)
+
+    return kernel
+
+
+ring_add = _ring2(ring.add)
+ring_sub = _ring2(ring.sub)
+ring_mul = _ring2(ring.mul)
+
+
+def ring_neg(x: HostRingTensor, plc: str) -> HostRingTensor:
+    lo, hi = ring.neg(x.lo, x.hi)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def ring_dot(x: HostRingTensor, y: HostRingTensor, plc: str) -> HostRingTensor:
+    lo, hi = ring.matmul(x.lo, x.hi, y.lo, y.hi)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def ring_sum(x: HostRingTensor, axis, plc: str) -> HostRingTensor:
+    lo, hi = ring.sum_(x.lo, x.hi, axis)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def ring_shl(x: HostRingTensor, amount: int, plc: str) -> HostRingTensor:
+    lo, hi = ring.shl(x.lo, x.hi, amount)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def ring_shr(x: HostRingTensor, amount: int, plc: str) -> HostRingTensor:
+    lo, hi = ring.shr(x.lo, x.hi, amount)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def ring_bit_extract(x: HostRingTensor, bit_idx: int, plc: str) -> HostBitTensor:
+    return HostBitTensor(ring.bit_extract(x.lo, x.hi, bit_idx), plc)
+
+
+def ring_inject(b: HostBitTensor, bit_idx: int, width: int, plc: str) -> HostRingTensor:
+    lo, hi = ring.from_bit(b.value, width)
+    lo, hi = ring.shl(lo, hi, bit_idx)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+def ring_decompose_bits(x: HostRingTensor, plc: str) -> HostBitTensor:
+    """All bits of a ring tensor, stacked on a new leading axis
+    (BitDecompose host kernel)."""
+    bits = [
+        ring.bit_extract(x.lo, x.hi, i) for i in range(x.width)
+    ]
+    return HostBitTensor(jnp.stack(bits, axis=0), plc)
+
+
+def ring_compose_bits(b: HostBitTensor, width: int, plc: str) -> HostRingTensor:
+    """Inverse of ring_decompose_bits (BitCompose host kernel)."""
+    lo = jnp.zeros(b.value.shape[1:], dtype=ring.U64)
+    hi = jnp.zeros_like(lo) if width == 128 else None
+    for i in range(width):
+        blo, bhi = ring.from_bit(b.value[i], width)
+        blo, bhi = ring.shl(blo, bhi, i)
+        lo, hi = ring.add(lo, hi, blo, bhi)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+# Structural ops shared by ring and plaintext tensors -----------------------
+
+
+def _map_ring_arrays(x: HostRingTensor, fn, plc: str) -> HostRingTensor:
+    lo = fn(x.lo)
+    hi = fn(x.hi) if x.hi is not None else None
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def _structural(fn_name):
+    """Build a kernel applying a jnp structural transform to any host
+    tensor kind."""
+
+    def kernel(x, plc: str, **kwargs):
+        fn = lambda a: getattr(jnp, fn_name)(a, **kwargs)
+        if isinstance(x, HostRingTensor):
+            return _map_ring_arrays(x, fn, plc)
+        if isinstance(x, HostBitTensor):
+            return HostBitTensor(fn(x.value), plc)
+        return HostTensor(fn(x.value), plc, x.dtype)
+
+    return kernel
+
+
+expand_dims = _structural("expand_dims")
+squeeze = _structural("squeeze")
+
+
+def transpose(x, plc: str):
+    fn = lambda a: jnp.transpose(a)
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def reshape(x, shp: HostShape, plc: str):
+    fn = lambda a: jnp.reshape(a, shp.value)
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def index_axis(x, axis: int, index: int, plc: str):
+    fn = lambda a: jnp.take(a, index, axis=axis)
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def slice_(x, begin, end, plc: str):
+    fn = lambda a: a[tuple(slice(b, e) for b, e in zip(begin, end))]
+    if isinstance(x, HostShape):
+        return HostShape(x.value[begin[0]:end[0]], plc)
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def strided_slice(x, slices, plc: str):
+    fn = lambda a: a[tuple(slices)]
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def concat(xs: Sequence, axis: int, plc: str):
+    x0 = xs[0]
+    if isinstance(x0, HostRingTensor):
+        lo = jnp.concatenate([x.lo for x in xs], axis=axis)
+        hi = (
+            jnp.concatenate([x.hi for x in xs], axis=axis)
+            if x0.hi is not None
+            else None
+        )
+        return HostRingTensor(lo, hi, x0.width, plc)
+    if isinstance(x0, HostBitTensor):
+        return HostBitTensor(
+            jnp.concatenate([x.value for x in xs], axis=axis), plc
+        )
+    return HostTensor(
+        jnp.concatenate([x.value for x in xs], axis=axis), plc, x0.dtype
+    )
+
+
+def broadcast(x, shp: HostShape, plc: str):
+    fn = lambda a: jnp.broadcast_to(a, shp.value)
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def diag(x, plc: str):
+    fn = jnp.diag
+    if isinstance(x, HostRingTensor):
+        return _map_ring_arrays(x, fn, plc)
+    return HostTensor(fn(x.value), plc, x.dtype)
+
+
+def shl_dim(x: HostRingTensor, amount: int, bit_length: int, plc: str):
+    """Rotate the leading (bit) axis by ``amount`` positions, filling with
+    zeros (used by bit-compose paths; reference ShlDim)."""
+    fn = lambda a: jnp.concatenate(
+        [jnp.zeros_like(a[:amount]), a[: bit_length - amount]], axis=0
+    )
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(fn(x.value), plc)
+    return _map_ring_arrays(x, fn, plc)
+
+
+# ---------------------------------------------------------------------------
+# Bit tensor kernels
+# ---------------------------------------------------------------------------
+
+
+def bit_xor(x: HostBitTensor, y: HostBitTensor, plc: str) -> HostBitTensor:
+    return HostBitTensor(x.value ^ y.value, plc)
+
+
+def bit_and(x: HostBitTensor, y: HostBitTensor, plc: str) -> HostBitTensor:
+    return HostBitTensor(x.value & y.value, plc)
+
+
+def bit_or(x: HostBitTensor, y: HostBitTensor, plc: str) -> HostBitTensor:
+    return HostBitTensor(x.value | y.value, plc)
+
+
+def bit_neg(x: HostBitTensor, plc: str) -> HostBitTensor:
+    return HostBitTensor(x.value ^ jnp.uint8(1), plc)
+
+
+# ---------------------------------------------------------------------------
+# Plaintext float/int kernels
+# ---------------------------------------------------------------------------
+
+
+def _f2(fn):
+    def kernel(x: HostTensor, y: HostTensor, plc: str) -> HostTensor:
+        return HostTensor(fn(x.value, y.value), plc, x.dtype)
+
+    return kernel
+
+
+add = _f2(jnp.add)
+sub = _f2(jnp.subtract)
+mul = _f2(jnp.multiply)
+div = _f2(jnp.divide)
+
+
+def dot(x: HostTensor, y: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.matmul(x.value, y.value), plc, x.dtype)
+
+
+def neg_(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(-x.value, plc, x.dtype)
+
+
+def sum_(x: HostTensor, axis, plc: str) -> HostTensor:
+    return HostTensor(jnp.sum(x.value, axis=axis), plc, x.dtype)
+
+
+def mean(x: HostTensor, axis, plc: str) -> HostTensor:
+    return HostTensor(jnp.mean(x.value, axis=axis), plc, x.dtype)
+
+
+def exp(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.exp(x.value), plc, x.dtype)
+
+
+def log(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.log(x.value), plc, x.dtype)
+
+
+def log2(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.log2(x.value), plc, x.dtype)
+
+
+def sqrt(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.sqrt(x.value), plc, x.dtype)
+
+
+def sigmoid(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jax.nn.sigmoid(x.value), plc, x.dtype)
+
+
+def relu(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.maximum(x.value, 0), plc, x.dtype)
+
+
+def abs_(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.abs(x.value), plc, x.dtype)
+
+
+def sign(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.sign(x.value), plc, x.dtype)
+
+
+def pow2(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.exp2(x.value), plc, x.dtype)
+
+
+def softmax(x: HostTensor, axis: int, plc: str) -> HostTensor:
+    return HostTensor(jax.nn.softmax(x.value, axis=axis), plc, x.dtype)
+
+
+def argmax(x: HostTensor, axis: int, plc: str) -> HostTensor:
+    return HostTensor(
+        jnp.argmax(x.value, axis=axis).astype(jnp.uint64), plc, dt.uint64
+    )
+
+
+def maximum(xs: Sequence[HostTensor], plc: str) -> HostTensor:
+    out = xs[0].value
+    for x in xs[1:]:
+        out = jnp.maximum(out, x.value)
+    return HostTensor(out, plc, xs[0].dtype)
+
+
+def inverse(x: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(jnp.linalg.inv(x.value), plc, x.dtype)
+
+
+def at_least_2d(x: HostTensor, to_column_vector: bool, plc: str) -> HostTensor:
+    v = x.value
+    if v.ndim == 0:
+        v = v.reshape(1, 1)
+    elif v.ndim == 1:
+        v = v.reshape(1, -1)
+        if to_column_vector:
+            v = v.T
+    return HostTensor(v, plc, x.dtype)
+
+
+def less(x: HostTensor, y: HostTensor, plc: str) -> HostBitTensor:
+    return HostBitTensor((x.value < y.value).astype(jnp.uint8), plc)
+
+
+def greater(x: HostTensor, y: HostTensor, plc: str) -> HostBitTensor:
+    return HostBitTensor((x.value > y.value).astype(jnp.uint8), plc)
+
+
+def equal(x, y, plc: str) -> HostBitTensor:
+    if isinstance(x, HostRingTensor):
+        return HostBitTensor(
+            ring.equal_bits(x.lo, x.hi, y.lo, y.hi), plc
+        )
+    return HostBitTensor((x.value == y.value).astype(jnp.uint8), plc)
+
+
+def mux(s: HostBitTensor, x: HostTensor, y: HostTensor, plc: str) -> HostTensor:
+    return HostTensor(
+        jnp.where(s.value.astype(bool), x.value, y.value), plc, x.dtype
+    )
+
+
+def cast(x, target: dt.DType, plc: str):
+    if isinstance(x, HostBitTensor):
+        if target.is_boolean:
+            return x
+        return HostTensor(
+            x.value.astype(np.dtype(target.numpy_name)), plc, target
+        )
+    if target.is_boolean:
+        return HostBitTensor((x.value != 0).astype(jnp.uint8), plc)
+    return HostTensor(x.value.astype(np.dtype(target.numpy_name)), plc, target)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-point encode/decode on host (reference host/fixedpoint.rs)
+# ---------------------------------------------------------------------------
+
+
+def ring_fixedpoint_encode(
+    x: HostTensor, frac_precision: int, width: int, plc: str
+) -> HostRingTensor:
+    lo, hi = ring.fixedpoint_encode(x.value, frac_precision, width)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+def ring_fixedpoint_decode(
+    x: HostRingTensor, frac_precision: int, plc: str, dtype: dt.DType = dt.float64
+) -> HostTensor:
+    v = ring.fixedpoint_decode(x.lo, x.hi, frac_precision)
+    return HostTensor(v.astype(np.dtype(dtype.numpy_name)), plc, dtype)
+
+
+def fixedpoint_encode(
+    x: HostTensor, integ: int, frac: int, width: int, plc: str
+) -> HostFixedTensor:
+    return HostFixedTensor(
+        ring_fixedpoint_encode(x, frac, width, plc), integ, frac
+    )
+
+
+def fixedpoint_decode(
+    x: HostFixedTensor, plc: str, dtype: dt.DType = dt.float64
+) -> HostTensor:
+    return ring_fixedpoint_decode(
+        x.tensor, x.fractional_precision, plc, dtype
+    )
+
+
+def ring_fixedpoint_mean(
+    x: HostRingTensor, axis, frac_precision: int, plc: str
+) -> HostRingTensor:
+    """Fixed-point mean: sum then multiply by encode(1/n) then shift back
+    down (reference RingFixedpointMean).  Returns a value scaled by
+    2^(2*frac) relative... — we instead fold the division into a single
+    multiply by round(2^frac / n) and keep scale, then TruncPr elsewhere."""
+    s = ring_sum(x, axis, plc)
+    n = x.lo.shape[axis] if axis is not None else int(np.prod(x.lo.shape))
+    factor = int(round((2.0 ** frac_precision) / n))
+    flo, fhi = ring.fill_like_shape((), x.width, factor)
+    lo, hi = ring.mul(s.lo, s.hi, flo, fhi)
+    return HostRingTensor(lo, hi, x.width, plc)

@@ -1,0 +1,415 @@
+"""Ring arithmetic over Z_{2^64} and Z_{2^128} on JAX arrays.
+
+TPU-native re-design of the reference's ``HostRingTensor<u64/u128>`` kernels
+(``moose/src/host/ops.rs``): the reference uses ndarray ``Wrapping<u64/u128>``
+on CPU.  TPUs have no native u128, so ring128 values are two-limb ``(hi, lo)``
+uint64 arrays; all carries are explicit.  XLA's unsigned integer arithmetic
+wraps, which is exactly ring semantics, so ring64 ops map 1:1 onto uint64
+lanes.
+
+Matmul strategies: the MXU only natively multiplies small floats/ints, so
+large ring matmuls can either use XLA's emulated u64 dot (``native``) or a
+limb-decomposition onto exact f32 matmuls (``limb_f32``) that ride the MXU:
+u64 is split into 8-bit limbs, limb products are exact in f32 for contraction
+chunks <= 256, partial sums recombine with shifts mod 2^64/2^128.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U64 = jnp.uint64
+MASK32 = np.uint64(0xFFFFFFFF)
+
+# Matmul strategy; "native" (XLA integer dot; CPU only — TPU XLA cannot
+# rewrite u64 dot_general) or "limb_f32" (MXU bf16 limb decomposition).
+# None = auto-select by backend on first use.
+_MATMUL_STRATEGY: Optional[str] = None
+
+
+def set_matmul_strategy(name: Optional[str]) -> None:
+    global _MATMUL_STRATEGY
+    assert name in (None, "native", "limb_f32")
+    _MATMUL_STRATEGY = name
+
+
+def get_matmul_strategy() -> str:
+    if _MATMUL_STRATEGY is None:
+        return "limb_f32" if jax.default_backend() == "tpu" else "native"
+    return _MATMUL_STRATEGY
+
+
+# ---------------------------------------------------------------------------
+# u64 helpers
+# ---------------------------------------------------------------------------
+
+
+def mulhi_u64(a, b):
+    """High 64 bits of the 128-bit product of two uint64 arrays, via 32-bit
+    halves (4 multiplies, schoolbook)."""
+    a = a.astype(U64)
+    b = b.astype(U64)
+    al = a & MASK32
+    ah = a >> np.uint64(32)
+    bl = b & MASK32
+    bh = b >> np.uint64(32)
+    t = al * bl
+    u = ah * bl + (t >> np.uint64(32))
+    v = al * bh + (u & MASK32)
+    return ah * bh + (u >> np.uint64(32)) + (v >> np.uint64(32))
+
+
+def mulwide_u64(a, b):
+    """(hi, lo) 128-bit product of uint64 arrays."""
+    return mulhi_u64(a, b), (a.astype(U64) * b.astype(U64))
+
+
+# ---------------------------------------------------------------------------
+# Ring element ops.  A ring value is (lo, hi) with hi=None for width 64.
+# ---------------------------------------------------------------------------
+
+
+def add(lo1, hi1, lo2, hi2):
+    lo = lo1 + lo2
+    if hi1 is None:
+        return lo, None
+    carry = (lo < lo1).astype(U64)
+    return lo, hi1 + hi2 + carry
+
+
+def sub(lo1, hi1, lo2, hi2):
+    lo = lo1 - lo2
+    if hi1 is None:
+        return lo, None
+    borrow = (lo1 < lo2).astype(U64)
+    return lo, hi1 - hi2 - borrow
+
+
+def neg(lo, hi):
+    if hi is None:
+        return (jnp.zeros_like(lo) - lo), None
+    nlo = jnp.zeros_like(lo) - lo
+    borrow = (lo != 0).astype(U64)
+    return nlo, jnp.zeros_like(hi) - hi - borrow
+
+
+def mul(lo1, hi1, lo2, hi2):
+    if hi1 is None:
+        return lo1 * lo2, None
+    p_hi, p_lo = mulwide_u64(lo1, lo2)
+    hi = p_hi + lo1 * hi2 + hi1 * lo2
+    return p_lo, hi
+
+
+def shl(lo, hi, amount: int):
+    """Logical left shift by a static amount."""
+    amount = int(amount)
+    if hi is None:
+        if amount >= 64:
+            return jnp.zeros_like(lo), None
+        return lo << np.uint64(amount), None
+    if amount == 0:
+        return lo, hi
+    if amount >= 128:
+        return jnp.zeros_like(lo), jnp.zeros_like(hi)
+    if amount >= 64:
+        return jnp.zeros_like(lo), lo << np.uint64(amount - 64)
+    a = np.uint64(amount)
+    return lo << a, (hi << a) | (lo >> np.uint64(64 - amount))
+
+
+def shr(lo, hi, amount: int):
+    """Logical right shift by a static amount."""
+    amount = int(amount)
+    if hi is None:
+        if amount >= 64:
+            return jnp.zeros_like(lo), None
+        return lo >> np.uint64(amount), None
+    if amount == 0:
+        return lo, hi
+    if amount >= 128:
+        return jnp.zeros_like(lo), jnp.zeros_like(hi)
+    if amount >= 64:
+        return hi >> np.uint64(amount - 64), jnp.zeros_like(hi)
+    a = np.uint64(amount)
+    return (lo >> a) | (hi << np.uint64(64 - amount)), hi >> a
+
+
+def bit_extract(lo, hi, bit_idx: int):
+    """Extract bit ``bit_idx`` as a uint8 0/1 array."""
+    bit_idx = int(bit_idx)
+    if bit_idx < 64:
+        return ((lo >> np.uint64(bit_idx)) & np.uint64(1)).astype(jnp.uint8)
+    return ((hi >> np.uint64(bit_idx - 64)) & np.uint64(1)).astype(jnp.uint8)
+
+
+def from_bit(bit, width: int):
+    """Inject a 0/1 uint8 array into the ring (RingInject with bit_idx=0)."""
+    lo = bit.astype(U64)
+    hi = jnp.zeros_like(lo) if width == 128 else None
+    return lo, hi
+
+
+def fill_like_shape(shape, width: int, value: int):
+    value = int(value) % (1 << width)
+    lo = jnp.full(shape, np.uint64(value & 0xFFFFFFFFFFFFFFFF), dtype=U64)
+    if width == 64:
+        return lo, None
+    hi = jnp.full(shape, np.uint64(value >> 64), dtype=U64)
+    return lo, hi
+
+
+def equal_bits(lo1, hi1, lo2, hi2):
+    """Plaintext ring equality -> uint8 0/1."""
+    eq = lo1 == lo2
+    if hi1 is not None:
+        eq = jnp.logical_and(eq, hi1 == hi2)
+    return eq.astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Sampling (counter-based PRF on device).
+#
+# The reference derives seeds with blake3 and expands them with AES-128-CTR
+# (``host/prim.rs:113-133``).  On TPU we use JAX's native threefry
+# counter-based PRF, keyed from the 128-bit seed: same security model
+# (PRF-expanded pairwise seeds), different stream — a documented deviation,
+# because protocol correctness only requires that the *same seed* yields the
+# *same stream on every party*.
+# ---------------------------------------------------------------------------
+
+
+def _key_from_seed(seed_u32x4):
+    """Derive a threefry key from a uint32[4] seed deterministically."""
+    k = seed_u32x4.astype(jnp.uint32)
+    data = (k[0].astype(U64) << np.uint64(32)) | k[1].astype(U64)
+    data2 = (k[2].astype(U64) << np.uint64(32)) | k[3].astype(U64)
+    key = jax.random.key(data ^ (data2 * np.uint64(0x9E3779B97F4A7C15)))
+    return key
+
+
+def sample_uniform_seeded(shape, seed_u32x4, width: int):
+    key = _key_from_seed(seed_u32x4)
+    shape = tuple(int(s) for s in shape)
+    if width == 64:
+        return jax.random.bits(key, shape, dtype=U64), None
+    k1, k2 = jax.random.split(key)
+    return (
+        jax.random.bits(k1, shape, dtype=U64),
+        jax.random.bits(k2, shape, dtype=U64),
+    )
+
+
+def sample_bits_seeded(shape, seed_u32x4, width: int):
+    key = _key_from_seed(seed_u32x4)
+    shape = tuple(int(s) for s in shape)
+    bits = jax.random.bits(key, shape, dtype=jnp.uint8) & jnp.uint8(1)
+    lo = bits.astype(U64)
+    hi = jnp.zeros_like(lo) if width == 128 else None
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# Contractions (Dot / matmul / sum)
+# ---------------------------------------------------------------------------
+
+
+def sum_(lo, hi, axis):
+    """Sum-reduce; wrapping accumulation is exact ring semantics for u64;
+    for u128 we accumulate limbs with carry counting."""
+    if hi is None:
+        return jnp.sum(lo, axis=axis, dtype=U64), None
+    # Accumulate lo with carry tracking: process via cumulative trick —
+    # sum of N uint64 values needs carry counts.  We chunk: add one by one is
+    # O(N); instead reduce pairwise with lax.reduce?  Simpler: use 32-bit
+    # split so partial sums are exact in u64, then recombine.
+    lo_lo = lo & MASK32
+    lo_hi = lo >> np.uint64(32)
+    s_ll = jnp.sum(lo_lo, axis=axis, dtype=U64)
+    s_lh = jnp.sum(lo_hi, axis=axis, dtype=U64)
+    s_hi = jnp.sum(hi, axis=axis, dtype=U64)
+    # result_lo128 = s_ll + (s_lh << 32), exact carries:
+    lo_out = s_ll + (s_lh << np.uint64(32))
+    carry = (s_lh >> np.uint64(32)) + (
+        ((s_ll + ((s_lh & MASK32) << np.uint64(32))) < s_ll).astype(U64)
+    )
+    return lo_out, s_hi + carry
+
+
+def _matmul_u64_native(a, b):
+    return jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())), preferred_element_type=U64
+    )
+
+
+def _limbs8_bf16(x, n_limbs: int):
+    """Split a uint64 array holding values < 2^(8*n_limbs) into 8-bit limbs
+    cast to bfloat16 (integers 0..255 are exactly representable in bf16)."""
+    return [
+        ((x >> np.uint64(8 * i)) & np.uint64(0xFF)).astype(jnp.bfloat16)
+        for i in range(n_limbs)
+    ]
+
+
+_CHUNK = 256  # limb products < 2^16; 256-term f32 accumulation stays < 2^24
+
+
+def _limb_matmul_pairs(a, b, in_limbs: int, out_limbs: int):
+    """Exact limb-decomposed matmul on the MXU.
+
+    ``a`` (m, k) and ``b`` (k, n) hold uint64 values < 2^(8*in_limbs).
+    Returns the list of per-diagonal partial sums [S_0 .. S_{out_limbs-1}]
+    as uint64 arrays, where S_s = sum_{i+j=s} A_i @ B_j and only s <
+    out_limbs is produced (higher limbs are truncated by the ring modulus).
+
+    Path: bf16 limbs -> MXU matmul with f32 accumulation (exact: products
+    < 2^16, chunked contraction of 256 terms < 2^24) -> u64 accumulation
+    across chunks (exact for any contraction length).
+    """
+    k = a.shape[-1]
+    pad = (-k) % _CHUNK
+    if pad:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+        b = jnp.pad(b, [(0, pad)] + [(0, 0)] * (b.ndim - 1))
+    nchunks = (k + pad) // _CHUNK
+    m, n = a.shape[0], b.shape[-1]
+    la = [
+        x.reshape(m, nchunks, _CHUNK).transpose(1, 0, 2)
+        for x in _limbs8_bf16(a, in_limbs)
+    ]
+    lb = [
+        x.reshape(nchunks, _CHUNK, n) for x in _limbs8_bf16(b, in_limbs)
+    ]
+    diags = []
+    for s in range(out_limbs):
+        ps = None
+        for i in range(min(s + 1, in_limbs)):
+            j = s - i
+            if j >= in_limbs:
+                continue
+            # batched over chunks: (c,m,256) @ (c,256,n) -> (c,m,n) in f32
+            p = jax.lax.dot_general(
+                la[i], lb[j], (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            # exact: convert to integer before cross-chunk/pair accumulation
+            pi = jnp.sum(p.astype(U64), axis=0)
+            ps = pi if ps is None else ps + pi
+        diags.append(ps if ps is not None else jnp.zeros((m, n), dtype=U64))
+    return diags
+
+
+def _matmul_u64_limb_f32(a, b):
+    """Exact u64 matmul (mod 2^64) on the MXU: 8 limbs, 36 bf16 matmuls."""
+    diags = _limb_matmul_pairs(a, b, in_limbs=8, out_limbs=8)
+    acc = jnp.zeros(a.shape[:-1] + b.shape[1:], dtype=U64)
+    for s, d in enumerate(diags):
+        acc = acc + (d << np.uint64(8 * s))
+    return acc
+
+
+def matmul(lo1, hi1, lo2, hi2):
+    """Ring matmul (Dot).  For u64 the wrapping u64 dot is exact ring math.
+    For u128 we decompose to 16-bit limbs, take exact u64 partial matmuls,
+    and recombine with 128-bit shifted adds."""
+    if hi1 is None:
+        if get_matmul_strategy() == "limb_f32":
+            return _matmul_u64_limb_f32(lo1, lo2), None
+        return _matmul_u64_native(lo1, lo2), None
+    return _matmul_u128(lo1, hi1, lo2, hi2)
+
+
+def _limbs16_128(lo, hi):
+    """Split a (hi, lo) u128 array into 8 limbs of 16 bits (u64 dtype)."""
+    limbs = []
+    for i in range(4):
+        limbs.append((lo >> np.uint64(16 * i)) & np.uint64(0xFFFF))
+    for i in range(4):
+        limbs.append((hi >> np.uint64(16 * i)) & np.uint64(0xFFFF))
+    return limbs
+
+
+def _matmul_u64_exact_small(a, b):
+    """Exact (non-wrapping) u64 matmul where inputs are < 2^16, so the full
+    result fits u64 for contraction dims < 2^31."""
+    if get_matmul_strategy() == "limb_f32":
+        diags = _limb_matmul_pairs(a, b, in_limbs=2, out_limbs=3)
+        acc = jnp.zeros(a.shape[:-1] + b.shape[1:], dtype=U64)
+        for s, d in enumerate(diags):
+            acc = acc + (d << np.uint64(8 * s))
+        return acc
+    return _matmul_u64_native(a, b)
+
+
+def _matmul_u128(lo1, hi1, lo2, hi2):
+    la = _limbs16_128(lo1, hi1)
+    lb = _limbs16_128(lo2, hi2)
+    out_shape = lo1.shape[:-1] + lo2.shape[1:]
+    rlo = jnp.zeros(out_shape, dtype=U64)
+    rhi = jnp.zeros(out_shape, dtype=U64)
+    for s in range(8):
+        ps = None
+        for i in range(s + 1):
+            j = s - i
+            p = _matmul_u64_exact_small(la[i], lb[j])
+            ps = p if ps is None else ps + p
+        add_lo, add_hi = shl(ps, jnp.zeros_like(ps), 16 * s)
+        rlo, rhi = add(rlo, rhi, add_lo, add_hi)
+    return rlo, rhi
+
+
+# ---------------------------------------------------------------------------
+# Fixed-point encode/decode (reference host/fixedpoint.rs)
+# ---------------------------------------------------------------------------
+
+
+def fixedpoint_encode(x, frac_precision: int, width: int):
+    """Encode floats into the ring: round(x * 2^f) two's complement.
+
+    Exactness caveat shared with the reference: the scaled value must fit in
+    float64's 53-bit mantissa to be exact.
+    """
+    scaled = jnp.round(x.astype(jnp.float64) * (2.0 ** frac_precision))
+    si = scaled.astype(jnp.int64)
+    lo = si.astype(U64)
+    if width == 64:
+        return lo, None
+    hi = (si >> np.int64(63)).astype(U64)  # sign extension
+    return lo, hi
+
+
+def fixedpoint_decode(lo, hi, frac_precision: int):
+    """Decode ring values to float64, interpreting as signed two's
+    complement."""
+    if hi is None:
+        signed = lo.astype(jnp.int64)
+        return signed.astype(jnp.float64) / (2.0 ** frac_precision)
+    signed_hi = hi.astype(jnp.int64)
+    v = signed_hi.astype(jnp.float64) * (2.0 ** 64) + lo.astype(jnp.float64)
+    return v / (2.0 ** frac_precision)
+
+
+# ---------------------------------------------------------------------------
+# numpy boundary helpers
+# ---------------------------------------------------------------------------
+
+
+def from_numpy_u64(arr: np.ndarray):
+    return jnp.asarray(arr.astype(np.uint64)), None
+
+
+def from_python_ints(arr, width: int):
+    """Build (lo, hi) from an array of Python ints (possibly >= 2^64)."""
+    a = np.asarray(arr, dtype=object)
+    lo = np.vectorize(lambda v: int(v) & 0xFFFFFFFFFFFFFFFF, otypes=[np.uint64])(a)
+    if width == 64:
+        return jnp.asarray(lo), None
+    hi = np.vectorize(
+        lambda v: (int(v) >> 64) & 0xFFFFFFFFFFFFFFFF, otypes=[np.uint64]
+    )(a)
+    return jnp.asarray(lo), jnp.asarray(hi)

@@ -1,0 +1,18 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+# exercised without TPU hardware (the driver separately dry-runs multichip).
+#
+# The container's sitecustomize imports jax and registers the axon TPU
+# plugin at interpreter startup, so JAX_PLATFORMS in os.environ is read too
+# early to override from here — use jax.config instead (backends are not yet
+# initialized when conftest loads).
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

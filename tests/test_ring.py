@@ -1,0 +1,171 @@
+"""Ring arithmetic unit tests, mirroring the reference's host-dialect tests
+(moose/src/host tests): wrapping semantics, 128-bit limbs, shifts, matmul,
+fixed-point encode/decode."""
+
+import numpy as np
+import pytest
+
+import moose_tpu  # noqa: F401  (enables x64)
+from moose_tpu.dialects import ring
+
+M64 = 1 << 64
+M128 = 1 << 128
+
+
+def as_int128(lo, hi):
+    lo = np.asarray(lo).astype(object)
+    hi = np.asarray(hi).astype(object)
+    return (hi << 64) + lo
+
+
+rng = np.random.default_rng(0)
+
+
+def rand_u128(shape):
+    return [
+        int(rng.integers(0, M64, dtype=np.uint64))
+        + (int(rng.integers(0, M64, dtype=np.uint64)) << 64)
+        for _ in range(int(np.prod(shape)))
+    ]
+
+
+class TestRing64:
+    def test_wrapping_add_mul(self):
+        a = np.array([2**63, 2**64 - 1, 5], dtype=np.uint64)
+        b = np.array([2**63, 2, 7], dtype=np.uint64)
+        lo, hi = ring.add(a, None, b, None)
+        assert hi is None
+        np.testing.assert_array_equal(
+            np.asarray(lo), (a.astype(object) + b.astype(object)) % M64
+        )
+        lo, _ = ring.mul(a, None, b, None)
+        np.testing.assert_array_equal(
+            np.asarray(lo), (a.astype(object) * b.astype(object)) % M64
+        )
+
+    def test_neg_sub(self):
+        a = np.array([0, 1, 2**63], dtype=np.uint64)
+        lo, _ = ring.neg(a, None)
+        np.testing.assert_array_equal(np.asarray(lo), (-a.astype(object)) % M64)
+
+    def test_shifts(self):
+        a = np.array([0xDEADBEEFCAFEBABE], dtype=np.uint64)
+        lo, _ = ring.shl(a, None, 13)
+        assert int(lo[0]) == (0xDEADBEEFCAFEBABE << 13) % M64
+        lo, _ = ring.shr(a, None, 13)
+        assert int(lo[0]) == 0xDEADBEEFCAFEBABE >> 13
+
+    def test_matmul_native(self):
+        a = rng.integers(0, M64, size=(4, 5), dtype=np.uint64)
+        b = rng.integers(0, M64, size=(5, 3), dtype=np.uint64)
+        lo, hi = ring.matmul(a, None, b, None)
+        expected = (a.astype(object) @ b.astype(object)) % M64
+        np.testing.assert_array_equal(np.asarray(lo).astype(object), expected)
+
+    def test_matmul_limb_f32(self):
+        a = rng.integers(0, M64, size=(4, 300), dtype=np.uint64)
+        b = rng.integers(0, M64, size=(300, 3), dtype=np.uint64)
+        ring.set_matmul_strategy("limb_f32")
+        try:
+            lo, hi = ring.matmul(a, None, b, None)
+        finally:
+            ring.set_matmul_strategy("native")
+        expected = (a.astype(object) @ b.astype(object)) % M64
+        np.testing.assert_array_equal(np.asarray(lo).astype(object), expected)
+
+
+class TestRing128:
+    def to_limbs(self, ints, shape):
+        lo = np.array([v % M64 for v in ints], dtype=np.uint64).reshape(shape)
+        hi = np.array([v >> 64 for v in ints], dtype=np.uint64).reshape(shape)
+        return lo, hi
+
+    def test_add_mul_sub(self):
+        xs = rand_u128((6,))
+        ys = rand_u128((6,))
+        xlo, xhi = self.to_limbs(xs, (6,))
+        ylo, yhi = self.to_limbs(ys, (6,))
+        lo, hi = ring.add(xlo, xhi, ylo, yhi)
+        np.testing.assert_array_equal(
+            as_int128(lo, hi),
+            np.array([(x + y) % M128 for x, y in zip(xs, ys)], dtype=object),
+        )
+        lo, hi = ring.mul(xlo, xhi, ylo, yhi)
+        np.testing.assert_array_equal(
+            as_int128(lo, hi),
+            np.array([(x * y) % M128 for x, y in zip(xs, ys)], dtype=object),
+        )
+        lo, hi = ring.sub(xlo, xhi, ylo, yhi)
+        np.testing.assert_array_equal(
+            as_int128(lo, hi),
+            np.array([(x - y) % M128 for x, y in zip(xs, ys)], dtype=object),
+        )
+
+    def test_shifts_cross_limb(self):
+        v = 0xDEADBEEFCAFEBABE0123456789ABCDEF
+        lo, hi = self.to_limbs([v], (1,))
+        for amt in (0, 1, 40, 64, 70, 127):
+            slo, shi = ring.shl(lo, hi, amt)
+            assert as_int128(slo, shi)[0] == (v << amt) % M128, amt
+            slo, shi = ring.shr(lo, hi, amt)
+            assert as_int128(slo, shi)[0] == v >> amt, amt
+
+    def test_matmul128(self):
+        xs = rand_u128((3, 4))
+        ys = rand_u128((4, 2))
+        xlo, xhi = self.to_limbs(xs, (3, 4))
+        ylo, yhi = self.to_limbs(ys, (4, 2))
+        a = np.array(xs, dtype=object).reshape(3, 4)
+        b = np.array(ys, dtype=object).reshape(4, 2)
+        lo, hi = ring.matmul(xlo, xhi, ylo, yhi)
+        np.testing.assert_array_equal(as_int128(lo, hi), (a @ b) % M128)
+
+    def test_sum(self):
+        xs = rand_u128((7,))
+        lo, hi = self.to_limbs(xs, (7,))
+        slo, shi = ring.sum_(lo, hi, 0)
+        assert as_int128(slo, shi) == sum(xs) % M128
+
+    def test_bit_extract(self):
+        v = (1 << 100) | (1 << 3)
+        lo, hi = self.to_limbs([v], (1,))
+        assert int(ring.bit_extract(lo, hi, 100)[0]) == 1
+        assert int(ring.bit_extract(lo, hi, 3)[0]) == 1
+        assert int(ring.bit_extract(lo, hi, 99)[0]) == 0
+
+
+class TestFixedpoint:
+    @pytest.mark.parametrize("width", [64, 128])
+    def test_roundtrip(self, width):
+        x = np.array([1.5, -2.25, 0.0, 1000.125, -0.0009765625])
+        lo, hi = ring.fixedpoint_encode(x, 40 if width == 128 else 20, width)
+        frac = 40 if width == 128 else 20
+        out = np.asarray(ring.fixedpoint_decode(lo, hi, frac))
+        np.testing.assert_allclose(out, x, atol=2.0 ** -frac)
+
+    def test_negative_two_complement(self):
+        x = np.array([-1.0])
+        lo, hi = ring.fixedpoint_encode(x, 40, 128)
+        v = as_int128(lo, hi)[0]
+        assert v == M128 - (1 << 40)
+
+
+class TestSampling:
+    def test_deterministic(self):
+        import jax.numpy as jnp
+
+        seed = jnp.array([1, 2, 3, 4], dtype=jnp.uint32)
+        a1, _ = ring.sample_uniform_seeded((4,), seed, 64)
+        a2, _ = ring.sample_uniform_seeded((4,), seed, 64)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        seed2 = jnp.array([1, 2, 3, 5], dtype=jnp.uint32)
+        b, _ = ring.sample_uniform_seeded((4,), seed2, 64)
+        assert not np.array_equal(np.asarray(a1), np.asarray(b))
+
+    def test_128_limbs_differ(self):
+        import jax.numpy as jnp
+
+        seed = jnp.array([9, 9, 9, 9], dtype=jnp.uint32)
+        lo, hi = ring.sample_uniform_seeded((8,), seed, 128)
+        assert hi is not None
+        assert not np.array_equal(np.asarray(lo), np.asarray(hi))
