@@ -385,12 +385,17 @@ def fixedpoint_encode(x, frac_precision: int, width: int):
 
 def fixedpoint_decode(lo, hi, frac_precision: int):
     """Decode ring values to float64, interpreting as signed two's
-    complement."""
+    complement.  Negatives are negated to magnitude *before* the float
+    conversion — float64(2^64 - small) would round the low bits away."""
     if hi is None:
         signed = lo.astype(jnp.int64)
         return signed.astype(jnp.float64) / (2.0 ** frac_precision)
-    signed_hi = hi.astype(jnp.int64)
-    v = signed_hi.astype(jnp.float64) * (2.0 ** 64) + lo.astype(jnp.float64)
+    negative = (hi >> np.uint64(63)) != 0
+    mlo, mhi = neg(lo, hi)
+    mag_lo = jnp.where(negative, mlo, lo)
+    mag_hi = jnp.where(negative, mhi, hi)
+    mag = mag_hi.astype(jnp.float64) * (2.0 ** 64) + mag_lo.astype(jnp.float64)
+    v = jnp.where(negative, -mag, mag)
     return v / (2.0 ** frac_precision)
 
 

@@ -1,0 +1,299 @@
+"""Sessions: the abstract host-primitive interface protocol kernels are
+written against.
+
+This reproduces the reference's architecturally load-bearing trick
+(``moose/src/execution/{synchronous,symbolic}.rs``): protocol kernels are
+written ONCE against an abstract session and serve both as the executable
+implementation (EagerSession -> jnp on device) and as the compiler's lowering
+rules (SymbolicSession -> append host-level ops to a new graph).  Under JAX
+the eager path is itself traceable, so a whole computation jit-compiles to a
+single fused XLA program.
+
+The session's method surface is the host dialect: every method takes the
+*host placement name* the op is pinned to.  Protocol dialects (replicated/
+additive/mirrored) are pure-Python compositions of these methods and never
+touch arrays directly.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as dt
+from ..dialects import host
+from ..values import (
+    HostBitTensor,
+    HostFixedTensor,
+    HostPrfKey,
+    HostRingTensor,
+    HostSeed,
+    HostShape,
+    HostTensor,
+)
+
+
+class EagerSession:
+    """Direct on-device execution of host kernels (reference SyncSession,
+    execution/synchronous.rs:20-27).
+
+    ``master_key`` seeds PRF-key generation: fresh keys are derived on device
+    from it per key-gen counter, so a jitted program can take the master key
+    as a runtime argument and reuse the compiled program across sessions with
+    fresh randomness (the reference's LocalRuntime likewise generates all
+    party keys inside one process).
+    """
+
+    def __init__(self, session_id: Optional[str] = None, master_key=None):
+        self.session_id = session_id or secrets.token_hex(8)
+        if master_key is None:
+            master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
+        self._master = jnp.asarray(master_key, dtype=jnp.uint32)
+        self._key_counter = 0
+        self._setup_cache: dict[str, object] = {}
+
+    # -- setup cache (reference execution/synchronous.rs:297-307) ----------
+
+    def replicated_setup(self, rep_plc):
+        from ..dialects import replicated
+
+        cache_key = (rep_plc.name, rep_plc.owners)
+        cached = self._setup_cache.get(cache_key)
+        if cached is None:
+            cached = replicated.gen_setup(self, rep_plc)
+            self._setup_cache[cache_key] = cached
+        return cached
+
+    # -- PRF keys & seeds --------------------------------------------------
+
+    def key_gen(self, plc: str) -> HostPrfKey:
+        from ..dialects import ring
+
+        idx = self._key_counter
+        self._key_counter += 1
+        k = ring._key_from_seed(self._master)
+        k = jax.random.fold_in(k, np.uint32(idx))
+        return HostPrfKey(jax.random.bits(k, (4,), dtype=jnp.uint32), plc)
+
+    def derive_seed(self, plc: str, key: HostPrfKey, sync_key: bytes) -> HostSeed:
+        return host.derive_seed(key, sync_key, plc)
+
+    def sample_uniform_seeded(self, plc, shp, seed, width: int):
+        return host.sample_uniform_seeded(shp, seed, width, plc)
+
+    def sample_bits_seeded(self, plc, shp, seed, width: int):
+        return host.sample_bits_seeded(shp, seed, width, plc)
+
+    def sample_bit_tensor_seeded(self, plc, shp, seed):
+        return host.sample_bit_tensor_seeded(shp, seed, plc)
+
+    # -- value movement ----------------------------------------------------
+
+    def place(self, plc: str, x):
+        """Claim/move a value onto a host placement.  Eagerly a relabel; in
+        distributed execution the compiler's networking pass turns
+        cross-host dataflow edges into Send/Recv pairs."""
+        return host.place(x, plc)
+
+    # -- structural / metadata --------------------------------------------
+
+    def shape(self, plc, x) -> HostShape:
+        return host.shape(x, plc)
+
+    def constant(self, plc, value, dtype=None):
+        return host.constant(value, plc, dtype)
+
+    def fill(self, plc, shp, value, ty_name: str):
+        return host.fill(shp, value, plc, ty_name)
+
+    def zeros(self, plc, shp, dtype=dt.float64):
+        return host.zeros(shp, dtype, plc)
+
+    def ones(self, plc, shp, dtype=dt.float64):
+        return host.ones(shp, dtype, plc)
+
+    def ring_zeros(self, plc, shp, width: int):
+        return host.ring_zeros(shp, width, plc)
+
+    def reshape(self, plc, x, shp):
+        return host.reshape(x, shp, plc)
+
+    def transpose(self, plc, x):
+        return host.transpose(x, plc)
+
+    def expand_dims(self, plc, x, axis):
+        return host.expand_dims(x, plc, axis=axis)
+
+    def squeeze(self, plc, x, axis=None):
+        return host.squeeze(x, plc, axis=axis)
+
+    def concat(self, plc, xs, axis=0):
+        return host.concat(xs, axis, plc)
+
+    def index_axis(self, plc, x, axis, index):
+        return host.index_axis(x, axis, index, plc)
+
+    def slice(self, plc, x, begin, end):
+        return host.slice_(x, begin, end, plc)
+
+    def strided_slice(self, plc, x, slices):
+        return host.strided_slice(x, slices, plc)
+
+    def broadcast(self, plc, x, shp):
+        return host.broadcast(x, shp, plc)
+
+    def diag(self, plc, x):
+        return host.diag(x, plc)
+
+    def shl_dim(self, plc, x, amount, bit_length):
+        return host.shl_dim(x, amount, bit_length, plc)
+
+    def at_least_2d(self, plc, x, to_column_vector=False):
+        return host.at_least_2d(x, to_column_vector, plc)
+
+    # -- arithmetic (dispatch on value kind) -------------------------------
+
+    @staticmethod
+    def _is_ring(x):
+        return isinstance(x, HostRingTensor)
+
+    def add(self, plc, x, y):
+        if self._is_ring(x):
+            return host.ring_add(x, y, plc)
+        return host.add(x, y, plc)
+
+    def sub(self, plc, x, y):
+        if self._is_ring(x):
+            return host.ring_sub(x, y, plc)
+        return host.sub(x, y, plc)
+
+    def mul(self, plc, x, y):
+        if self._is_ring(x):
+            return host.ring_mul(x, y, plc)
+        if isinstance(x, HostBitTensor):
+            return host.bit_and(x, y, plc)
+        return host.mul(x, y, plc)
+
+    def div(self, plc, x, y):
+        return host.div(x, y, plc)
+
+    def dot(self, plc, x, y):
+        if self._is_ring(x):
+            return host.ring_dot(x, y, plc)
+        return host.dot(x, y, plc)
+
+    def neg(self, plc, x):
+        if self._is_ring(x):
+            return host.ring_neg(x, plc)
+        return host.neg_(x, plc)
+
+    def sum(self, plc, x, axis=None):
+        if self._is_ring(x):
+            return host.ring_sum(x, axis, plc)
+        return host.sum_(x, axis, plc)
+
+    def mean(self, plc, x, axis=None):
+        return host.mean(x, axis, plc)
+
+    def shl(self, plc, x, amount: int):
+        return host.ring_shl(x, amount, plc)
+
+    def shr(self, plc, x, amount: int):
+        return host.ring_shr(x, amount, plc)
+
+    # -- bits --------------------------------------------------------------
+
+    def xor(self, plc, x, y):
+        return host.bit_xor(x, y, plc)
+
+    def and_(self, plc, x, y):
+        return host.bit_and(x, y, plc)
+
+    def or_(self, plc, x, y):
+        return host.bit_or(x, y, plc)
+
+    def bit_neg(self, plc, x):
+        return host.bit_neg(x, plc)
+
+    def bit_extract(self, plc, x, bit_idx: int):
+        return host.ring_bit_extract(x, bit_idx, plc)
+
+    def ring_inject(self, plc, b, bit_idx: int, width: int):
+        return host.ring_inject(b, bit_idx, width, plc)
+
+    def decompose_bits(self, plc, x):
+        return host.ring_decompose_bits(x, plc)
+
+    def compose_bits(self, plc, b, width: int):
+        return host.ring_compose_bits(b, width, plc)
+
+    # -- fixed-point -------------------------------------------------------
+
+    def ring_fixedpoint_encode(self, plc, x, frac: int, width: int):
+        return host.ring_fixedpoint_encode(x, frac, width, plc)
+
+    def ring_fixedpoint_decode(self, plc, x, frac: int, dtype=dt.float64):
+        return host.ring_fixedpoint_decode(x, frac, plc, dtype)
+
+    def ring_fixedpoint_mean(self, plc, x, axis, frac: int):
+        return host.ring_fixedpoint_mean(x, axis, frac, plc)
+
+    # -- plaintext math ----------------------------------------------------
+
+    def exp(self, plc, x):
+        return host.exp(x, plc)
+
+    def log(self, plc, x):
+        return host.log(x, plc)
+
+    def log2(self, plc, x):
+        return host.log2(x, plc)
+
+    def sqrt(self, plc, x):
+        return host.sqrt(x, plc)
+
+    def sigmoid(self, plc, x):
+        return host.sigmoid(x, plc)
+
+    def relu(self, plc, x):
+        return host.relu(x, plc)
+
+    def abs(self, plc, x):
+        return host.abs_(x, plc)
+
+    def sign(self, plc, x):
+        return host.sign(x, plc)
+
+    def pow2(self, plc, x):
+        return host.pow2(x, plc)
+
+    def softmax(self, plc, x, axis):
+        return host.softmax(x, axis, plc)
+
+    def argmax(self, plc, x, axis):
+        return host.argmax(x, axis, plc)
+
+    def maximum(self, plc, xs):
+        return host.maximum(xs, plc)
+
+    def inverse(self, plc, x):
+        return host.inverse(x, plc)
+
+    def less(self, plc, x, y):
+        return host.less(x, y, plc)
+
+    def greater(self, plc, x, y):
+        return host.greater(x, y, plc)
+
+    def equal(self, plc, x, y):
+        return host.equal(x, y, plc)
+
+    def mux(self, plc, s, x, y):
+        return host.mux(s, x, y, plc)
+
+    def cast(self, plc, x, target: dt.DType):
+        return host.cast(x, target, plc)
