@@ -100,6 +100,12 @@ def ring_zeros(shp: HostShape, width: int, plc: str) -> HostRingTensor:
     return HostRingTensor(lo, hi, width, plc)
 
 
+def ring_constant(ints, width: int, plc: str) -> HostRingTensor:
+    """Public ring tensor from an array of Python ints (mod 2^width)."""
+    lo, hi = ring.from_python_ints(ints, width)
+    return HostRingTensor(lo, hi, width, plc)
+
+
 # ---------------------------------------------------------------------------
 # PRF keys & seeds (reference host/prim.rs)
 # ---------------------------------------------------------------------------

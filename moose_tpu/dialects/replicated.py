@@ -593,6 +593,27 @@ def b2a(sess, rep, bit: RepTensor, width: int) -> RepTensor:
     return arith_xor(arith_xor(a0, a1), a2)
 
 
+def b2a_bits(sess, rep, bits: RepTensor, width: int) -> RepTensor:
+    """Vectorized b2a over a whole (stacked) bit tensor: one pair of
+    replicated multiplications regardless of how many bits — crucial to keep
+    trace size linear (the reference converts per-bit via dabits)."""
+    return b2a(sess, rep, bits, width)
+
+
+def weighted_bit_sum(sess, rep, bits_ring: RepTensor, weights, width: int) -> RepTensor:
+    """sum_i bits_ring[i] * weights[i] along the leading axis, with public
+    integer weights broadcast against the remaining axes."""
+    import numpy as np
+
+    p = rep.owners
+    w = np.asarray(weights, dtype=object).reshape(
+        (len(weights),) + (1,) * (len(bits_ring.shares[0][0].shape) - 1)
+    )
+    cs = [sess.ring_constant(p[i], w, width) for i in range(3)]
+    prod = mul_public(sess, rep, bits_ring, cs)
+    return sum_(sess, rep, prod, 0)
+
+
 # ---------------------------------------------------------------------------
 # Comparison / selection (replicated/{compare,control_flow}.rs)
 # ---------------------------------------------------------------------------

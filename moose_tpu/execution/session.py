@@ -119,6 +119,9 @@ class EagerSession:
     def ring_zeros(self, plc, shp, width: int):
         return host.ring_zeros(shp, width, plc)
 
+    def ring_constant(self, plc, ints, width: int):
+        return host.ring_constant(ints, width, plc)
+
     def reshape(self, plc, x, shp):
         return host.reshape(x, shp, plc)
 

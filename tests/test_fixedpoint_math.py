@@ -1,0 +1,143 @@
+"""Secure fixed-point math library tests, mirroring the reference's
+integration tolerances (pymoose/rust_integration_tests/*: exp, softmax,
+argmax, division, sigmoid)."""
+
+import numpy as np
+import pytest
+
+import moose_tpu  # noqa: F401
+from moose_tpu.computation import ReplicatedPlacement
+from moose_tpu.dialects import fixedpoint as fx
+from moose_tpu.dialects import replicated, ring
+from moose_tpu.execution.session import EagerSession
+from moose_tpu.values import HostRingTensor, RepFixedTensor, to_numpy
+
+rep = ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+
+I, F = 24, 40  # the predictor default fixed(24, 40) -> ring128
+WIDTH = 128
+
+
+def shared_fixed(sess, x, i=I, f=F, width=WIDTH):
+    lo, hi = ring.fixedpoint_encode(np.asarray(x, dtype=np.float64), f, width)
+    t = replicated.share(sess, rep, HostRingTensor(lo, hi, width, "alice"))
+    return RepFixedTensor(t, i, f)
+
+
+def revealed(sess, xf: RepFixedTensor, frac=None):
+    out = replicated.reveal(sess, rep, xf.tensor, "alice")
+    frac = xf.fractional_precision if frac is None else frac
+    return np.asarray(ring.fixedpoint_decode(out.lo, out.hi, frac))
+
+
+class TestDiv:
+    def test_division(self):
+        sess = EagerSession()
+        x = np.array([1.0, -3.5, 10.0, 0.5])
+        y = np.array([2.0, 7.0, 3.0, 8.0])
+        xs = shared_fixed(sess, x)
+        ys = shared_fixed(sess, y)
+        z = fx.div(sess, rep, xs, ys)
+        np.testing.assert_allclose(revealed(sess, z), x / y, atol=1e-5)
+
+    def test_division_small_ring(self):
+        sess = EagerSession()
+        x = np.array([1.0, 9.0])
+        y = np.array([4.0, 3.0])
+        xs = shared_fixed(sess, x, i=10, f=15, width=64)
+        ys = shared_fixed(sess, y, i=10, f=15, width=64)
+        z = fx.div(sess, rep, xs, ys)
+        np.testing.assert_allclose(revealed(sess, z), x / y, atol=1e-2)
+
+
+class TestExpLog:
+    def test_pow2(self):
+        sess = EagerSession()
+        x = np.array([2.0, 0.5, -1.5, 0.0, 3.25])
+        xs = shared_fixed(sess, x)
+        z = fx.pow2(sess, rep, xs)
+        np.testing.assert_allclose(revealed(sess, z), 2.0 ** x, rtol=1e-4)
+
+    def test_exp(self):
+        sess = EagerSession()
+        x = np.array([0.0, 1.0, -2.0, 2.5])
+        xs = shared_fixed(sess, x)
+        z = fx.exp(sess, rep, xs)
+        np.testing.assert_allclose(revealed(sess, z), np.exp(x), rtol=1e-4)
+
+    def test_log2_log(self):
+        sess = EagerSession()
+        x = np.array([1.0, 2.0, 0.25, 10.0, 3.14159])
+        xs = shared_fixed(sess, x)
+        z = fx.log2(sess, rep, xs)
+        np.testing.assert_allclose(revealed(sess, z), np.log2(x), atol=1e-3)
+        zl = fx.log(sess, rep, shared_fixed(sess, x))
+        np.testing.assert_allclose(revealed(sess, zl), np.log(x), atol=1e-3)
+
+    def test_sqrt(self):
+        sess = EagerSession()
+        x = np.array([4.0, 2.0, 0.25, 9.0])
+        xs = shared_fixed(sess, x)
+        z = fx.sqrt(sess, rep, xs)
+        np.testing.assert_allclose(revealed(sess, z), np.sqrt(x), atol=1e-3)
+
+    def test_sigmoid(self):
+        sess = EagerSession()
+        x = np.array([0.0, 1.0, -1.0, 4.0, -4.0])
+        xs = shared_fixed(sess, x)
+        z = fx.sigmoid(sess, rep, xs)
+        np.testing.assert_allclose(
+            revealed(sess, z), 1.0 / (1.0 + np.exp(-x)), atol=1e-4
+        )
+
+
+class TestMaxArgmaxSoftmax:
+    def test_maximum(self):
+        sess = EagerSession()
+        arrays = [np.array([1.0, 5.0]), np.array([3.0, 2.0]), np.array([-1.0, 7.0])]
+        xs = [shared_fixed(sess, a) for a in arrays]
+        z = fx.maximum(sess, rep, xs)
+        np.testing.assert_allclose(
+            revealed(sess, z), np.maximum.reduce(arrays), atol=1e-9
+        )
+
+    def test_argmax(self):
+        sess = EagerSession()
+        x = np.array([[1.0, 5.0, 3.0, -2.0], [4.0, 0.0, 9.0, 2.0]])
+        xs = shared_fixed(sess, x)
+        idx = fx.argmax(sess, rep, xs, axis=1, upmost_index=4)
+        out = replicated.reveal(sess, rep, idx, "alice")
+        got = np.asarray(to_numpy(out)).astype(np.int64)
+        np.testing.assert_array_equal(got, np.argmax(x, axis=1))
+
+    def test_softmax(self):
+        sess = EagerSession()
+        x = np.array([[1.0, 2.0, 3.0], [0.5, -0.5, 0.0]])
+        xs = shared_fixed(sess, x)
+        z = fx.softmax(sess, rep, xs, axis=1, upmost_index=3)
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        expected = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(revealed(sess, z), expected, atol=1e-3)
+
+
+class TestNorm:
+    def test_top_most_matches_reference_vector(self):
+        # reference division.rs test_norm: x=896 (3.5*2^8), max_bits=12
+        # -> topmost 4, upshifted 3584
+        sess = EagerSession()
+        x = HostRingTensor(*ring.from_python_ints([896], 64), 64, "alice")
+        xs = replicated.share(sess, rep, x)
+        up, top = fx.norm(sess, rep, xs, 12)
+        top_out = np.asarray(to_numpy(replicated.reveal(sess, rep, top, "alice")))
+        up_out = np.asarray(to_numpy(replicated.reveal(sess, rep, up, "alice")))
+        assert int(top_out[0]) == 4
+        assert int(up_out[0]) == 3584
+
+    def test_approximate_reciprocal(self):
+        # reference: x = 3.5*2^8, int=4, frac=8 -> approx 1/3.5 * 2^8 = 74
+        sess = EagerSession()
+        x = HostRingTensor(*ring.from_python_ints([896], 64), 64, "alice")
+        xs = replicated.share(sess, rep, x)
+        w = fx.approximate_reciprocal(sess, rep, xs, 4, 8)
+        out = np.asarray(to_numpy(replicated.reveal(sess, rep, w, "alice")))
+        assert abs(int(out[0]) - 74) <= 1
