@@ -6,6 +6,9 @@ with 3-party replicated secret sharing over Z_{2^64}/Z_{2^128}) built on
 JAX/XLA: host kernels are jnp programs, the 3 parties ride a named mesh axis
 with ICI collectives, and whole computations compile to single fused XLA
 programs instead of per-op task graphs.
+
+The public surface mirrors ``pymoose`` (reference pymoose/pymoose/__init__.py)
+so existing ``@pm.computation`` graphs run unchanged.
 """
 
 import jax
@@ -34,45 +37,99 @@ from .computation import (  # noqa: E402
     Operation,
     ReplicatedPlacement,
 )
+from .vtypes import (  # noqa: E402
+    AesKeyType,
+    AesTensorType,
+    BytesType,
+    FloatType,
+    IntType,
+    ShapeType,
+    StringType,
+    TensorType,
+    UnitType,
+)
+from .edsl.base import (  # noqa: E402
+    Argument,
+    abs,
+    add,
+    add_n,
+    argmax,
+    atleast_2d,
+    cast,
+    computation,
+    concatenate,
+    constant,
+    decrypt,
+    div,
+    dot,
+    equal,
+    exp,
+    expand_dims,
+    get_current_placement,
+    get_current_runtime,
+    greater,
+    host_placement,
+    identity,
+    index_axis,
+    inverse,
+    less,
+    load,
+    log,
+    log2,
+    logical_and,
+    logical_or,
+    logical_xor,
+    maximum,
+    mean,
+    mirrored_placement,
+    mul,
+    mux,
+    neg,
+    ones,
+    output,
+    relu,
+    replicated_placement,
+    reshape,
+    save,
+    select,
+    set_current_runtime,
+    shape,
+    sigmoid,
+    sliced,
+    softmax,
+    sqrt,
+    square,
+    squeeze,
+    strided_slice,
+    sub,
+    sum,
+    transpose,
+    zeros,
+)
 
 __version__ = "0.1.0"
 
-__all__ = [
-    "dtypes",
-    "bool_",
-    "fixed",
-    "fixed64",
-    "fixed128",
-    "float32",
-    "float64",
-    "int32",
-    "int64",
-    "uint32",
-    "uint64",
-    "AdditivePlacement",
-    "Computation",
-    "HostPlacement",
-    "Mirrored3Placement",
-    "Operation",
-    "ReplicatedPlacement",
-]
-
 
 def __getattr__(name):
-    # Lazy imports to keep `import moose_tpu` light and avoid cycles.
-    if name in ("computation", "host_placement", "replicated_placement",
-                "mirrored_placement", "Argument", "edsl"):
-        from . import edsl
-
-        if name == "edsl":
-            return edsl
-        return getattr(edsl.base, name)
+    # Lazy imports of heavier subsystems to keep `import moose_tpu` light.
     if name in ("LocalMooseRuntime", "GrpcMooseRuntime"):
         from . import runtime
 
         return getattr(runtime, name)
+    if name == "runtime":
+        from . import runtime
+
+        return runtime
     if name == "predictors":
         from . import predictors
 
         return predictors
+    if name == "elk_compiler":
+        from . import elk_compiler
+
+        return elk_compiler
+    if name == "testing":
+        from . import testing
+
+        return testing
     raise AttributeError(f"module 'moose_tpu' has no attribute {name!r}")

@@ -124,12 +124,10 @@ def key_gen(plc: str, key_words) -> HostPrfKey:
 
 def derive_seed(key: HostPrfKey, sync_key: bytes, plc: str) -> HostSeed:
     """Derive a 128-bit seed from a PRF key and a static nonce
-    (reference: blake3 keyed hash, host/prim.rs:123; here threefry)."""
+    (reference: blake3 keyed hash, host/prim.rs:123; here one PRF draw
+    keyed by a key/nonce mix — see ring.mix_seed)."""
     words = np.frombuffer(sync_key[:16].ljust(16, b"\0"), dtype=np.uint32)
-    k = ring._key_from_seed(key.value)
-    for w in words:
-        k = jax.random.fold_in(k, np.uint32(w))
-    return HostSeed(jax.random.bits(k, (4,), dtype=jnp.uint32), plc)
+    return HostSeed(ring.mix_seed(key.value, words), plc)
 
 
 def sample_uniform_seeded(
@@ -195,6 +193,11 @@ def ring_shr(x: HostRingTensor, amount: int, plc: str) -> HostRingTensor:
     return HostRingTensor(lo, hi, x.width, plc)
 
 
+def ring_shr_arith(x: HostRingTensor, amount: int, plc: str) -> HostRingTensor:
+    lo, hi = ring.shr_arith(x.lo, x.hi, amount)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
 def ring_bit_extract(x: HostRingTensor, bit_idx: int, plc: str) -> HostBitTensor:
     return HostBitTensor(ring.bit_extract(x.lo, x.hi, bit_idx), plc)
 
@@ -207,21 +210,29 @@ def ring_inject(b: HostBitTensor, bit_idx: int, width: int, plc: str) -> HostRin
 
 def ring_decompose_bits(x: HostRingTensor, plc: str) -> HostBitTensor:
     """All bits of a ring tensor, stacked on a new leading axis
-    (BitDecompose host kernel)."""
-    bits = [
-        ring.bit_extract(x.lo, x.hi, i) for i in range(x.width)
-    ]
-    return HostBitTensor(jnp.stack(bits, axis=0), plc)
+    (BitDecompose host kernel) — one broadcast shift per limb, not a
+    per-bit Python loop."""
+    shifts = jnp.arange(64, dtype=ring.U64).reshape((64,) + (1,) * x.lo.ndim)
+    bits_lo = ((x.lo[None, ...] >> shifts) & jnp.uint64(1)).astype(jnp.uint8)
+    if x.width == 64:
+        return HostBitTensor(bits_lo, plc)
+    bits_hi = ((x.hi[None, ...] >> shifts) & jnp.uint64(1)).astype(jnp.uint8)
+    return HostBitTensor(
+        jnp.concatenate([bits_lo, bits_hi], axis=0), plc
+    )
 
 
 def ring_compose_bits(b: HostBitTensor, width: int, plc: str) -> HostRingTensor:
-    """Inverse of ring_decompose_bits (BitCompose host kernel)."""
-    lo = jnp.zeros(b.value.shape[1:], dtype=ring.U64)
-    hi = jnp.zeros_like(lo) if width == 128 else None
-    for i in range(width):
-        blo, bhi = ring.from_bit(b.value[i], width)
-        blo, bhi = ring.shl(blo, bhi, i)
-        lo, hi = ring.add(lo, hi, blo, bhi)
+    """Inverse of ring_decompose_bits (BitCompose host kernel): weighted sum
+    with power-of-two weights, vectorized over the bit axis."""
+    bits = b.value.astype(ring.U64)
+    weights = (
+        jnp.uint64(1) << jnp.arange(64, dtype=ring.U64)
+    ).reshape((64,) + (1,) * (b.value.ndim - 1))
+    lo = jnp.sum(bits[:64] * weights[: min(width, 64)], axis=0, dtype=ring.U64)
+    if width == 64:
+        return HostRingTensor(lo, None, width, plc)
+    hi = jnp.sum(bits[64:128] * weights, axis=0, dtype=ring.U64)
     return HostRingTensor(lo, hi, width, plc)
 
 
@@ -488,6 +499,36 @@ def equal(x, y, plc: str) -> HostBitTensor:
 def mux(s: HostBitTensor, x: HostTensor, y: HostTensor, plc: str) -> HostTensor:
     return HostTensor(
         jnp.where(s.value.astype(bool), x.value, y.value), plc, x.dtype
+    )
+
+
+def select(x, axis: int, index: HostBitTensor, plc: str):
+    """Filter entries along ``axis`` by a boolean mask (reference SelectOp,
+    host/ops.rs:605).  Output shape is data-dependent, so computations using
+    Select are executed eagerly (outside jit) by the interpreter."""
+    mask = np.asarray(index.value).astype(bool)
+    if isinstance(x, HostRingTensor):
+        lo = np.compress(mask, np.asarray(x.lo), axis=axis)
+        hi = (
+            np.compress(mask, np.asarray(x.hi), axis=axis)
+            if x.hi is not None
+            else None
+        )
+        return HostRingTensor(jnp.asarray(lo), None if hi is None else jnp.asarray(hi), x.width, plc)
+    if isinstance(x, HostFixedTensor):
+        return HostFixedTensor(
+            select(x.tensor, axis, index, plc),
+            x.integral_precision,
+            x.fractional_precision,
+        )
+    if isinstance(x, HostBitTensor):
+        return HostBitTensor(
+            jnp.asarray(np.compress(mask, np.asarray(x.value), axis=axis)), plc
+        )
+    return HostTensor(
+        jnp.asarray(np.compress(mask, np.asarray(x.value), axis=axis)),
+        plc,
+        x.dtype,
     )
 
 

@@ -1,0 +1,826 @@
+"""Logical dialect: dtype- and placement-polymorphic dispatch of IR ops.
+
+Re-design of the reference's logical dialect (``moose/src/logical/ops.rs``):
+each logical operation pattern-matches on (placement kind, runtime value kind)
+and forwards to host / fixedpoint / replicated / mirrored kernels.  Implicit
+conversions mirror the reference's lowering behavior: feeding a host value
+into a replicated op shares it; placing a replicated value on a host op
+reveals it; mirrored values demirror on hosts and act as public constants on
+replicated placements.
+
+Deviations (documented, TPU-first):
+- Plaintext *host* fixed-point math functions (exp/log/sqrt/sigmoid/softmax)
+  decode -> float64 -> re-encode instead of running ring polynomial kernels:
+  the values are plaintext, XLA float math is exact enough for the fixed
+  encoding, and it keeps host graphs on the TPU fast path.  The secure
+  replicated path uses the exact ring protocols in ``fixedpoint.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import dtypes as dt
+from ..computation import (
+    Computation,
+    HostPlacement,
+    Mirrored3Placement,
+    Operation,
+    ReplicatedPlacement,
+)
+from ..values import (
+    HostBitTensor,
+    HostFixedTensor,
+    HostRingTensor,
+    HostShape,
+    HostString,
+    HostTensor,
+    HostUnit,
+    Mir3FixedTensor,
+    Mir3Tensor,
+    RepFixedTensor,
+    RepTensor,
+)
+from . import fixedpoint as fx
+from . import host
+from . import mirrored as mir_ops
+from . import replicated as rep_ops
+
+
+def _width_of_dtype(dtype: dt.DType) -> int:
+    return 64 if dtype.name == "fixed64" else 128
+
+
+# ---------------------------------------------------------------------------
+# Implicit conversions
+# ---------------------------------------------------------------------------
+
+
+def to_host(sess, plc_name: str, v):
+    """Materialize any logical value as a host value on ``plc_name``."""
+    if isinstance(v, (HostTensor, HostBitTensor, HostRingTensor, HostShape,
+                      HostString, HostUnit)):
+        return sess.place(plc_name, v)
+    if isinstance(v, HostFixedTensor):
+        return HostFixedTensor(
+            sess.place(plc_name, v.tensor),
+            v.integral_precision,
+            v.fractional_precision,
+        )
+    if isinstance(v, RepFixedTensor):
+        rep = _rep_placement_of(sess, v.tensor.plc)
+        ring = rep_ops.reveal(sess, rep, v.tensor, plc_name)
+        return HostFixedTensor(
+            ring, v.integral_precision, v.fractional_precision
+        )
+    if isinstance(v, RepTensor):
+        rep = _rep_placement_of(sess, v.plc)
+        return rep_ops.reveal(sess, rep, v, plc_name)
+    if isinstance(v, Mir3FixedTensor):
+        return HostFixedTensor(
+            mir_ops.demirror(sess, _mir_placement_of(sess, v.tensor.plc),
+                             v.tensor, plc_name),
+            v.integral_precision,
+            v.fractional_precision,
+        )
+    if isinstance(v, Mir3Tensor):
+        return mir_ops.demirror(
+            sess, _mir_placement_of(sess, v.plc), v, plc_name
+        )
+    raise TypeError(f"cannot place {type(v).__name__} on host {plc_name}")
+
+
+def to_rep(sess, rep: ReplicatedPlacement, v):
+    """Materialize any logical tensor value as a replicated sharing."""
+    if isinstance(v, (RepFixedTensor, RepTensor)):
+        return v
+    if isinstance(v, HostFixedTensor):
+        return RepFixedTensor(
+            rep_ops.share(sess, rep, v.tensor),
+            v.integral_precision,
+            v.fractional_precision,
+        )
+    if isinstance(v, HostBitTensor):
+        return rep_ops.share(sess, rep, v)
+    if isinstance(v, HostRingTensor):
+        return rep_ops.share(sess, rep, v)
+    if isinstance(v, Mir3FixedTensor):
+        h = to_host(sess, rep.owners[0], v)
+        return to_rep(sess, rep, h)
+    if isinstance(v, HostTensor):
+        raise TypeError(
+            "cannot share a plaintext float tensor; cast to a fixed dtype "
+            "first (reference requires FixedpointEncode before Share)"
+        )
+    raise TypeError(f"cannot share {type(v).__name__}")
+
+
+# Placement registry so conversions can find owners from a placement name.
+# Populated per-execution by the interpreter via bind_placements().
+
+
+def bind_placements(sess, comp: Computation):
+    sess._placements = comp.placements
+
+
+def _rep_placement_of(sess, name: str) -> ReplicatedPlacement:
+    plc = sess._placements[name]
+    assert isinstance(plc, ReplicatedPlacement)
+    return plc
+
+
+def _mir_placement_of(sess, name: str) -> Mirrored3Placement:
+    plc = sess._placements[name]
+    assert isinstance(plc, Mirrored3Placement)
+    return plc
+
+
+# ---------------------------------------------------------------------------
+# Host fixed-point helpers (plaintext ring arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def _host_fixed_binop(sess, plc, x: HostFixedTensor, y: HostFixedTensor, op):
+    assert x.fractional_precision == y.fractional_precision
+    f = x.fractional_precision
+    i = max(x.integral_precision, y.integral_precision)
+    a, b = x.tensor, y.tensor
+    if op == "Add":
+        z = host.ring_add(a, b, plc)
+    elif op == "Sub":
+        z = host.ring_sub(a, b, plc)
+    elif op == "Mul":
+        z = host.ring_shr_arith(host.ring_mul(a, b, plc), f, plc)
+    elif op == "Dot":
+        z = host.ring_shr_arith(host.ring_dot(a, b, plc), f, plc)
+    else:
+        raise ValueError(op)
+    return HostFixedTensor(z, i, f)
+
+
+def _host_fixed_via_float(sess, plc, op_fn, x: HostFixedTensor):
+    v = host.fixedpoint_decode(x, plc)
+    out = op_fn(v)
+    return host.fixedpoint_encode(
+        out, x.integral_precision, x.fractional_precision, x.tensor.width, plc
+    )
+
+
+# ---------------------------------------------------------------------------
+# Replicated helpers for ops not in fixedpoint.py
+# ---------------------------------------------------------------------------
+
+
+def _rep_zeros_like(sess, rep, x: RepFixedTensor) -> RepTensor:
+    shp = fx._shape_of(sess, rep, x.tensor)
+    return rep_ops.fill(sess, rep, shp, 0, fx._width_of(x.tensor))
+
+
+def _rep_relu(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
+    sign = rep_ops.msb(sess, rep, x.tensor)
+    zeros = _rep_zeros_like(sess, rep, x)
+    out = rep_ops.mux_bit(sess, rep, sign, zeros, x.tensor)
+    return RepFixedTensor(out, x.integral_precision, x.fractional_precision)
+
+
+def _rep_abs(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
+    sign = rep_ops.msb(sess, rep, x.tensor)
+    negx = rep_ops.neg(sess, rep, x.tensor)
+    out = rep_ops.mux_bit(sess, rep, sign, negx, x.tensor)
+    return RepFixedTensor(out, x.integral_precision, x.fractional_precision)
+
+
+def _mirrored_to_public_ring(v):
+    """Extract the 3 per-party host ring tensors from a mirrored fixed."""
+    if isinstance(v, Mir3FixedTensor):
+        return v.tensor.values, v.fractional_precision
+    raise TypeError(type(v).__name__)
+
+
+# ---------------------------------------------------------------------------
+# The dispatcher
+# ---------------------------------------------------------------------------
+
+
+_STRUCTURAL_SESS_METHOD = {
+    "Reshape": "reshape",
+    "ExpandDims": "expand_dims",
+    "Squeeze": "squeeze",
+    "Transpose": "transpose",
+    "IndexAxis": "index_axis",
+    "AtLeast2D": "at_least_2d",
+    "Broadcast": "broadcast",
+}
+
+_REP_STRUCTURAL = {
+    "Reshape": rep_ops.reshape,
+    "ExpandDims": rep_ops.expand_dims,
+    "Squeeze": rep_ops.squeeze,
+    "Transpose": rep_ops.transpose,
+    "IndexAxis": rep_ops.index_axis,
+}
+
+_HOST_MATH = {
+    "Exp": host.exp,
+    "Log": host.log,
+    "Log2": host.log2,
+    "Sqrt": host.sqrt,
+    "Sigmoid": host.sigmoid,
+    "Relu": host.relu,
+    "Abs": host.abs_,
+}
+
+_REP_MATH = {
+    "Exp": fx.exp,
+    "Log": fx.log,
+    "Log2": fx.log2,
+    "Sqrt": fx.sqrt,
+    "Sigmoid": fx.sigmoid,
+}
+
+
+def execute_op(sess, comp: Computation, op: Operation, args: list):
+    """Execute one logical operation given its already-computed inputs."""
+    plc = comp.placement_of(op)
+    kind = op.kind
+
+    if isinstance(plc, HostPlacement):
+        return _execute_host(sess, comp, op, plc, args)
+    if isinstance(plc, ReplicatedPlacement):
+        return _execute_rep(sess, comp, op, plc, args)
+    if isinstance(plc, Mirrored3Placement):
+        return _execute_mir(sess, comp, op, plc, args)
+    raise TypeError(f"unsupported placement {plc!r} for op {op.name}")
+
+
+# -- host placement ---------------------------------------------------------
+
+
+def _execute_host(sess, comp, op, plc: HostPlacement, args):
+    kind = op.kind
+    h = plc.name
+    ret_dtype = op.signature.return_type.dtype
+
+    if kind == "Constant":
+        return _constant_on_host(sess, h, op)
+    if kind == "Identity":
+        return to_host(sess, h, args[0])
+    if kind == "Output":
+        return to_host(sess, h, args[0])
+    if kind == "Cast":
+        return _cast_on_host(sess, h, args[0], ret_dtype)
+    if kind == "Shape":
+        x = to_host(sess, h, args[0])
+        if isinstance(x, HostFixedTensor):
+            x = x.tensor
+        return sess.shape(h, x)
+    if kind in ("Ones", "Zeros"):
+        shp = to_host(sess, h, args[0])
+        fn = sess.ones if kind == "Ones" else sess.zeros
+        return fn(h, shp, ret_dtype or dt.float64)
+    if kind == "Inverse":
+        return sess.inverse(h, to_host(sess, h, args[0]))
+
+    if kind in ("Add", "Sub", "Mul", "Div", "Dot"):
+        x = to_host(sess, h, args[0])
+        y = to_host(sess, h, args[1])
+        if isinstance(x, HostFixedTensor) or isinstance(y, HostFixedTensor):
+            if kind == "Div":
+                # plaintext fixed division via float (documented deviation)
+                xv = host.fixedpoint_decode(x, h)
+                yv = host.fixedpoint_decode(y, h)
+                out = sess.div(h, xv, yv)
+                return host.fixedpoint_encode(
+                    out, x.integral_precision, x.fractional_precision,
+                    x.tensor.width, h,
+                )
+            return _host_fixed_binop(sess, h, x, y, kind)
+        fn = {
+            "Add": sess.add, "Sub": sess.sub, "Mul": sess.mul,
+            "Div": sess.div, "Dot": sess.dot,
+        }[kind]
+        return fn(h, x, y)
+
+    if kind == "AddN":
+        vals = [to_host(sess, h, a) for a in args]
+        out = vals[0]
+        for v in vals[1:]:
+            out = (
+                _host_fixed_binop(sess, h, out, v, "Add")
+                if isinstance(out, HostFixedTensor)
+                else sess.add(h, out, v)
+            )
+        return out
+
+    if kind == "Neg":
+        x = to_host(sess, h, args[0])
+        if isinstance(x, HostFixedTensor):
+            return HostFixedTensor(
+                host.ring_neg(x.tensor, h),
+                x.integral_precision,
+                x.fractional_precision,
+            )
+        return sess.neg(h, x)
+
+    if kind in ("Less", "Greater", "Equal"):
+        x = to_host(sess, h, args[0])
+        y = to_host(sess, h, args[1])
+        if isinstance(x, HostFixedTensor):
+            x = host.fixedpoint_decode(x, h)
+        if isinstance(y, HostFixedTensor):
+            y = host.fixedpoint_decode(y, h)
+        fn = {"Less": sess.less, "Greater": sess.greater,
+              "Equal": sess.equal}[kind]
+        return fn(h, x, y)
+
+    if kind in ("And", "Or", "Xor"):
+        x = to_host(sess, h, args[0])
+        y = to_host(sess, h, args[1])
+        fn = {"And": sess.and_, "Or": sess.or_, "Xor": sess.xor}[kind]
+        return fn(h, x, y)
+
+    if kind == "Mux":
+        s = to_host(sess, h, args[0])
+        x = to_host(sess, h, args[1])
+        y = to_host(sess, h, args[2])
+        if isinstance(x, HostFixedTensor):
+            sel = s.value.astype(x.tensor.lo.dtype)
+            import jax.numpy as jnp
+
+            lo = jnp.where(s.value != 0, x.tensor.lo, y.tensor.lo)
+            hi = (
+                jnp.where(s.value != 0, x.tensor.hi, y.tensor.hi)
+                if x.tensor.hi is not None
+                else None
+            )
+            return HostFixedTensor(
+                HostRingTensor(lo, hi, x.tensor.width, h),
+                x.integral_precision,
+                x.fractional_precision,
+            )
+        return sess.mux(h, s, x, y)
+
+    if kind in ("Sum", "Mean"):
+        x = to_host(sess, h, args[0])
+        axis = op.attributes.get("axis")
+        if isinstance(x, HostFixedTensor):
+            if kind == "Sum":
+                return HostFixedTensor(
+                    host.ring_sum(x.tensor, axis, h),
+                    x.integral_precision,
+                    x.fractional_precision,
+                )
+            scaled = host.ring_fixedpoint_mean(
+                x.tensor, axis, x.fractional_precision, h
+            )
+            return HostFixedTensor(
+                host.ring_shr_arith(scaled, x.fractional_precision, h),
+                x.integral_precision,
+                x.fractional_precision,
+            )
+        fn = sess.sum if kind == "Sum" else sess.mean
+        return fn(h, x, axis)
+
+    if kind in _HOST_MATH:
+        x = to_host(sess, h, args[0])
+        if isinstance(x, HostFixedTensor):
+            return _host_fixed_via_float(
+                sess, h, lambda v: _HOST_MATH[kind](v, h), x
+            )
+        return _HOST_MATH[kind](x, h)
+
+    if kind == "Softmax":
+        x = to_host(sess, h, args[0])
+        axis = op.attributes["axis"]
+        if isinstance(x, HostFixedTensor):
+            return _host_fixed_via_float(
+                sess, h, lambda v: host.softmax(v, axis, h), x
+            )
+        return host.softmax(x, axis, h)
+
+    if kind == "Argmax":
+        x = to_host(sess, h, args[0])
+        axis = op.attributes["axis"]
+        if isinstance(x, HostFixedTensor):
+            x = host.fixedpoint_decode(x, h)
+        return host.argmax(x, axis, h)
+
+    if kind == "Maximum":
+        vals = [to_host(sess, h, a) for a in args]
+        if isinstance(vals[0], HostFixedTensor):
+            f = vals[0].fractional_precision
+            i = vals[0].integral_precision
+            w = vals[0].tensor.width
+            floats = [host.fixedpoint_decode(v, h) for v in vals]
+            return host.fixedpoint_encode(host.maximum(floats, h), i, f, w, h)
+        return sess.maximum(h, vals)
+
+    if kind == "Concat":
+        vals = [to_host(sess, h, a) for a in args]
+        axis = op.attributes.get("axis", 0)
+        if isinstance(vals[0], HostFixedTensor):
+            rings = [v.tensor for v in vals]
+            return HostFixedTensor(
+                sess.concat(h, rings, axis),
+                vals[0].integral_precision,
+                vals[0].fractional_precision,
+            )
+        return sess.concat(h, vals, axis)
+
+    if kind in _STRUCTURAL_SESS_METHOD:
+        return _host_structural(sess, comp, op, h, args)
+
+    if kind == "Slice":
+        return _host_slice(sess, op, h, args)
+
+    if kind == "Select":
+        x = to_host(sess, h, args[0])
+        index = to_host(sess, h, args[1])
+        axis = op.attributes["axis"]
+        return host.select(x, axis, index, h)
+
+    if kind == "Decrypt":
+        from . import aes
+
+        return aes.decrypt_host(sess, h, args[0], args[1], op)
+
+    raise NotImplementedError(f"host op {kind} ({op.name})")
+
+
+def _constant_on_host(sess, h, op):
+    value = op.attributes["value"]
+    ret = op.signature.return_type
+    if isinstance(value, str):
+        return HostString(value, h)
+    if ret.name == "HostShape":
+        return HostShape(tuple(int(d) for d in np.asarray(value)), h)
+    dtype = ret.dtype
+    if dtype is not None and dtype.is_fixedpoint:
+        t = host.constant(np.asarray(value, dtype=np.float64), h, dt.float64)
+        return host.fixedpoint_encode(
+            t,
+            dtype.integral_precision,
+            dtype.fractional_precision,
+            _width_of_dtype(dtype),
+            h,
+        )
+    if isinstance(value, (int, float)):
+        return value  # static scalar (IntType/FloatType)
+    return host.constant(np.asarray(value), h, dtype)
+
+
+def _cast_on_host(sess, h, v, target: dt.DType):
+    v = to_host(sess, h, v)
+    if target.is_fixedpoint:
+        if isinstance(v, HostFixedTensor):
+            return v
+        assert isinstance(v, HostTensor)
+        return host.fixedpoint_encode(
+            v,
+            target.integral_precision,
+            target.fractional_precision,
+            _width_of_dtype(target),
+            h,
+        )
+    if isinstance(v, HostFixedTensor):
+        return host.fixedpoint_decode(v, h, target)
+    if isinstance(v, HostRingTensor):
+        # e.g. revealed argmax indices
+        t = HostTensor(v.lo, h, dt.uint64)
+        return host.cast(t, target, h)
+    return host.cast(v, target, h)
+
+
+def _host_structural(sess, comp, op, h, args):
+    kind = op.kind
+    x = to_host(sess, h, args[0])
+    is_fixed = isinstance(x, HostFixedTensor)
+    inner = x.tensor if is_fixed else x
+
+    if kind == "Reshape":
+        shp = to_host(sess, h, args[1])
+        out = sess.reshape(h, inner, shp)
+    elif kind == "Broadcast":
+        shp = to_host(sess, h, args[1])
+        out = sess.broadcast(h, inner, shp)
+    elif kind == "ExpandDims":
+        axes = op.attributes["axis"]
+        out = inner
+        for a in sorted(axes):
+            out = sess.expand_dims(h, out, a)
+    elif kind == "Squeeze":
+        out = sess.squeeze(h, inner, op.attributes.get("axis"))
+    elif kind == "Transpose":
+        out = sess.transpose(h, inner)
+    elif kind == "IndexAxis":
+        out = sess.index_axis(
+            h, inner, op.attributes["axis"], op.attributes["index"]
+        )
+    elif kind == "AtLeast2D":
+        out = sess.at_least_2d(
+            h, inner, op.attributes.get("to_column_vector", False)
+        )
+    else:
+        raise NotImplementedError(kind)
+    if is_fixed:
+        return HostFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+    return out
+
+
+def _host_slice(sess, op, h, args):
+    x = to_host(sess, h, args[0])
+    if "slices" in op.attributes:
+        spec = tuple(
+            slice(b, e, s) for (b, e, s) in op.attributes["slices"]
+        )
+    else:
+        spec = (slice(op.attributes["begin"], op.attributes["end"]),)
+    if isinstance(x, HostShape):
+        assert len(spec) == 1
+        return HostShape(x.value[spec[0]], h)
+    is_fixed = isinstance(x, HostFixedTensor)
+    inner = x.tensor if is_fixed else x
+    out = sess.strided_slice(h, inner, spec)
+    if is_fixed:
+        return HostFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+    return out
+
+
+# -- replicated placement ---------------------------------------------------
+
+
+def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
+    kind = op.kind
+    rep = plc
+    ret_dtype = op.signature.return_type.dtype
+
+    def fixed_args():
+        return [to_rep(sess, rep, a) for a in args]
+
+    if kind == "Identity":
+        return to_rep(sess, rep, args[0])
+
+    if kind in ("Add", "Sub", "Mul", "Dot", "Div"):
+        x, y = args
+        # Mirrored public operand paths
+        if isinstance(y, Mir3FixedTensor) and kind in ("Add", "Sub", "Mul"):
+            xr = to_rep(sess, rep, x)
+            return _rep_public_binop(sess, rep, xr, y, kind, right=True)
+        if isinstance(x, Mir3FixedTensor) and kind in ("Add", "Sub", "Mul"):
+            yr = to_rep(sess, rep, y)
+            return _rep_public_binop(sess, rep, yr, x, kind, right=False)
+        xr = to_rep(sess, rep, x)
+        yr = to_rep(sess, rep, y)
+        fn = {"Add": fx.add, "Sub": fx.sub, "Mul": fx.mul, "Dot": fx.dot,
+              "Div": fx.div}[kind]
+        return fn(sess, rep, xr, yr)
+
+    if kind == "AddN":
+        vals = fixed_args()
+        out = vals[0]
+        for v in vals[1:]:
+            out = fx.add(sess, rep, out, v)
+        return out
+
+    if kind == "Neg":
+        x = to_rep(sess, rep, args[0])
+        return fx.neg(sess, rep, x)
+
+    if kind in ("Less", "Greater"):
+        x = to_rep(sess, rep, args[0])
+        y = to_rep(sess, rep, args[1])
+        if kind == "Less":
+            return rep_ops.less(sess, rep, x.tensor, y.tensor)
+        return rep_ops.greater(sess, rep, x.tensor, y.tensor)
+
+    if kind in ("And", "Or", "Xor"):
+        x = to_rep(sess, rep, args[0])
+        y = to_rep(sess, rep, args[1])
+        fn = {"And": rep_ops.and_bits, "Or": rep_ops.or_bits,
+              "Xor": rep_ops.xor}[kind]
+        return fn(sess, rep, x, y)
+
+    if kind == "Mux":
+        s = to_rep(sess, rep, args[0])  # RepTensor bits
+        x = to_rep(sess, rep, args[1])
+        y = to_rep(sess, rep, args[2])
+        out = rep_ops.mux_bit(sess, rep, s, x.tensor, y.tensor)
+        return RepFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+
+    if kind in ("Sum", "Mean"):
+        x = to_rep(sess, rep, args[0])
+        axis = op.attributes.get("axis")
+        fn = fx.sum_ if kind == "Sum" else fx.mean
+        return fn(sess, rep, x, axis)
+
+    if kind in _REP_MATH:
+        x = to_rep(sess, rep, args[0])
+        return _REP_MATH[kind](sess, rep, x)
+
+    if kind == "Relu":
+        return _rep_relu(sess, rep, to_rep(sess, rep, args[0]))
+
+    if kind == "Abs":
+        return _rep_abs(sess, rep, to_rep(sess, rep, args[0]))
+
+    if kind == "Softmax":
+        x = to_rep(sess, rep, args[0])
+        return fx.softmax(
+            sess, rep, x, op.attributes["axis"], op.attributes["upmost_index"]
+        )
+
+    if kind == "Argmax":
+        x = to_rep(sess, rep, args[0])
+        return fx.argmax(
+            sess, rep, x, op.attributes["axis"], op.attributes["upmost_index"]
+        )
+
+    if kind == "Maximum":
+        vals = fixed_args()
+        return fx.maximum(sess, rep, vals)
+
+    if kind == "Concat":
+        vals = fixed_args()
+        axis = op.attributes.get("axis", 0)
+        out = rep_ops.concat(sess, rep, [v.tensor for v in vals], axis)
+        return RepFixedTensor(
+            out, vals[0].integral_precision, vals[0].fractional_precision
+        )
+
+    if kind in _REP_STRUCTURAL:
+        x = to_rep(sess, rep, args[0])
+        return _rep_structural(sess, comp, op, rep, x, args)
+
+    if kind == "Slice":
+        x = to_rep(sess, rep, args[0])
+        if "slices" in op.attributes:
+            spec = tuple(
+                slice(b, e, s) for (b, e, s) in op.attributes["slices"]
+            )
+        else:
+            spec = (slice(op.attributes["begin"], op.attributes["end"]),)
+        if isinstance(x, RepFixedTensor):
+            out = rep_ops.strided_slice(sess, rep, x.tensor, spec)
+            return RepFixedTensor(
+                out, x.integral_precision, x.fractional_precision
+            )
+        return rep_ops.strided_slice(sess, rep, x, spec)
+
+    if kind == "Shape":
+        x = to_rep(sess, rep, args[0])
+        inner = x.tensor if isinstance(x, RepFixedTensor) else x
+        return fx._shape_of(sess, rep, inner)
+
+    if kind == "Cast":
+        # fixed->fixed precision moves; anything else must go via a host.
+        x = to_rep(sess, rep, args[0])
+        assert ret_dtype is not None and ret_dtype.is_fixedpoint
+        assert isinstance(x, RepFixedTensor)
+        cur_f = x.fractional_precision
+        new_f = ret_dtype.fractional_precision
+        t = x.tensor
+        if new_f > cur_f:
+            t = rep_ops.shl(sess, rep, t, new_f - cur_f)
+        elif new_f < cur_f:
+            t = rep_ops.trunc_pr(sess, rep, t, cur_f - new_f)
+        return RepFixedTensor(
+            t, ret_dtype.integral_precision, new_f
+        )
+
+    if kind == "Decrypt":
+        from . import aes
+
+        return aes.decrypt_rep(sess, rep, args[0], args[1], op)
+
+    raise NotImplementedError(f"replicated op {kind} ({op.name})")
+
+
+def _rep_public_binop(sess, rep, x: RepFixedTensor, pub: Mir3FixedTensor,
+                      kind: str, right: bool):
+    """x (+|-|*) mirrored-public value without extra sharing rounds
+    (reference fixedpoint dialect Mir ops)."""
+    values, pub_f = _mirrored_to_public_ring(pub)
+    assert pub_f == x.fractional_precision
+    if kind == "Add":
+        out = rep_ops.add_public(
+            sess, rep, x.tensor, values[0], c_on_p2=values[2]
+        )
+        return RepFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+    if kind == "Sub":
+        if right:
+            out = rep_ops.sub_public(
+                sess, rep, x.tensor, values[0], c_on_p2=values[2]
+            )
+        else:
+            # pub - x = -(x - pub)
+            out = rep_ops.sub_public(
+                sess, rep, x.tensor, values[0], c_on_p2=values[2]
+            )
+            out = rep_ops.neg(sess, rep, out)
+        return RepFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+    if kind == "Mul":
+        out = rep_ops.mul_public(sess, rep, x.tensor, values)
+        out = rep_ops.trunc_pr(sess, rep, out, x.fractional_precision)
+        return RepFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+    raise ValueError(kind)
+
+
+def _rep_structural(sess, comp, op, rep, x, args):
+    kind = op.kind
+    is_fixed = isinstance(x, RepFixedTensor)
+    inner = x.tensor if is_fixed else x
+    fn = _REP_STRUCTURAL[kind]
+    if kind == "Reshape":
+        shp = to_host(sess, rep.owners[0], args[1])
+        out = fn(sess, rep, inner, shp)
+    elif kind == "ExpandDims":
+        axes = op.attributes["axis"]
+        out = inner
+        for a in sorted(axes):
+            out = fn(sess, rep, out, axis=a)
+    elif kind == "Squeeze":
+        out = fn(sess, rep, inner, op.attributes.get("axis"))
+    elif kind == "IndexAxis":
+        out = fn(sess, rep, inner, op.attributes["axis"],
+                 op.attributes["index"])
+    else:
+        out = fn(sess, rep, inner)
+    if is_fixed:
+        return RepFixedTensor(
+            out, x.integral_precision, x.fractional_precision
+        )
+    return out
+
+
+# -- mirrored placement -----------------------------------------------------
+
+
+def _execute_mir(sess, comp, op, plc: Mirrored3Placement, args):
+    kind = op.kind
+    mir = plc
+    ret_dtype = op.signature.return_type.dtype
+
+    if kind == "Constant":
+        value = op.attributes["value"]
+        if ret_dtype is not None and ret_dtype.is_fixedpoint:
+            width = _width_of_dtype(ret_dtype)
+            vals = []
+            for owner in mir.owners:
+                t = host.constant(
+                    np.asarray(value, dtype=np.float64), owner, dt.float64
+                )
+                vals.append(
+                    host.ring_fixedpoint_encode(
+                        t, ret_dtype.fractional_precision, width, owner
+                    )
+                )
+            return Mir3FixedTensor(
+                Mir3Tensor(tuple(vals), mir.name),
+                ret_dtype.integral_precision,
+                ret_dtype.fractional_precision,
+            )
+        vals = tuple(
+            host.constant(np.asarray(value), owner, ret_dtype)
+            for owner in mir.owners
+        )
+        return Mir3Tensor(vals, mir.name)
+
+    if kind == "Cast":
+        v = args[0]
+        assert ret_dtype is not None
+        if isinstance(v, Mir3Tensor) and ret_dtype.is_fixedpoint:
+            width = _width_of_dtype(ret_dtype)
+            vals = tuple(
+                host.ring_fixedpoint_encode(
+                    t, ret_dtype.fractional_precision, width, t.plc
+                )
+                for t in v.values
+            )
+            return Mir3FixedTensor(
+                Mir3Tensor(vals, mir.name),
+                ret_dtype.integral_precision,
+                ret_dtype.fractional_precision,
+            )
+        if isinstance(v, Mir3FixedTensor) and not ret_dtype.is_fixedpoint:
+            vals = tuple(
+                host.ring_fixedpoint_decode(
+                    t, v.fractional_precision, t.plc, ret_dtype
+                )
+                for t in v.tensor.values
+            )
+            return Mir3Tensor(vals, mir.name)
+        raise NotImplementedError("mirrored cast variant")
+
+    raise NotImplementedError(f"mirrored op {kind} ({op.name})")

@@ -440,15 +440,35 @@ def rep_to_adt(sess, adt, x: RepTensor) -> AdtTensor:
 
 
 def adt_to_rep(sess, rep, x: AdtTensor) -> RepTensor:
-    """Re-share each additive share into the replicated placement and add.
-
-    Simpler than the reference's PRF-optimized AdtToRepOp
-    (additive/convert.rs) at the cost of one extra sharing round; the
-    round-trip disappears under XLA fusion in single-program execution.
-    """
-    r0 = share(sess, rep, x.shares[0])
-    r1 = share(sess, rep, x.shares[1])
-    return add(sess, rep, r0, r1)
+    """PRF-compressed conversion of a 2-party additive sharing held by
+    (p0, p1) into a replicated sharing (reference AdtToRepOp,
+    additive/convert.rs): with y0 = PRF(k_0) (derivable by p0 and p2 from
+    the setup key they share), y1 = x0 - y0 and y2 = x1, the triple
+    (y0, y1, y2) replicates x0 + x1 using a single fresh PRF draw and one
+    value transfer per neighbor."""
+    p = rep.owners
+    x0, x1 = x.shares
+    if (x0.plc, x1.plc) != (p[0], p[1]):
+        # generic owners: fall back to re-share-and-add
+        r0 = share(sess, rep, x0)
+        r1 = share(sess, rep, x1)
+        return add(sess, rep, r0, r1)
+    setup = sess.replicated_setup(rep)
+    nonce = random_sync_key()
+    shp = sess.shape(p[0], x0)
+    width = x0.width
+    # k_0 is held by party 0 (first slot) and party 2 (second slot).
+    s_at_p0 = sess.derive_seed(p[0], setup.keys[0][0], nonce)
+    s_at_p2 = sess.derive_seed(p[2], setup.keys[2][1], nonce)
+    y0_at_p0 = sess.sample_uniform_seeded(p[0], shp, s_at_p0, width)
+    y0_at_p2 = sess.sample_uniform_seeded(p[2], shp, s_at_p2, width)
+    y1 = sess.sub(p[0], x0, y0_at_p0)
+    shares = (
+        (y0_at_p0, y1),
+        (sess.place(p[1], y1), sess.place(p[1], x1)),
+        (sess.place(p[2], x1), y0_at_p2),
+    )
+    return RepTensor(shares, rep.name)
 
 
 # ---------------------------------------------------------------------------

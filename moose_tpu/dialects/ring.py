@@ -140,6 +140,36 @@ def shr(lo, hi, amount: int):
     return (lo >> a) | (hi << np.uint64(64 - amount)), hi >> a
 
 
+def shr_arith(lo, hi, amount: int):
+    """Arithmetic (sign-extending) right shift by a static amount.
+
+    Used for plaintext host fixed-point truncation (the reference truncates
+    host fixed tensors with a signed shift, fixedpoint/ops.rs host kernels);
+    the secure replicated path uses TruncPr instead.
+    """
+    amount = int(amount)
+    if hi is None:
+        if amount == 0:
+            return lo, None
+        amount = min(amount, 63)
+        return (lo.astype(jnp.int64) >> np.int64(amount)).astype(U64), None
+    if amount == 0:
+        return lo, hi
+    sign_fill = (hi.astype(jnp.int64) >> np.int64(63)).astype(U64)
+    if amount >= 128:
+        return sign_fill, sign_fill
+    if amount >= 64:
+        a = min(amount - 64, 63)
+        new_lo = (hi.astype(jnp.int64) >> np.int64(a)).astype(U64)
+        if amount == 64:
+            new_lo = hi
+        return new_lo, sign_fill
+    a = np.uint64(amount)
+    new_lo = (lo >> a) | (hi << np.uint64(64 - amount))
+    new_hi = (hi.astype(jnp.int64) >> np.int64(amount)).astype(U64)
+    return new_lo, new_hi
+
+
 def bit_extract(lo, hi, bit_idx: int):
     """Extract bit ``bit_idx`` as a uint8 0/1 array."""
     bit_idx = int(bit_idx)
@@ -176,21 +206,54 @@ def equal_bits(lo1, hi1, lo2, hi2):
 # Sampling (counter-based PRF on device).
 #
 # The reference derives seeds with blake3 and expands them with AES-128-CTR
-# (``host/prim.rs:113-133``).  On TPU we use JAX's native threefry
-# counter-based PRF, keyed from the 128-bit seed: same security model
-# (PRF-expanded pairwise seeds), different stream — a documented deviation,
-# because protocol correctness only requires that the *same seed* yields the
-# *same stream on every party*.
+# (``host/prim.rs:113-133``).  On TPU we expand seeds with XLA's native
+# ``RngBitGenerator`` (Philox counter PRF, ONE fused HLO op) via JAX's
+# ``rbg`` PRNG implementation.  The protocol only needs the *same seed* to
+# yield the *same stream on every party holding it*; Philox provides that
+# deterministically within a backend.  The threefry path (a stronger,
+# reduced-Threefish PRF, ~100 HLO ops per draw) is available via
+# ``set_prf_impl("threefry")`` for strict deployments — a documented
+# deviation either way, since neither is the reference's AES-CTR.
+#
+# IMPORTANT: rbg streams are only guaranteed identical within one backend
+# and jaxlib version.  Heterogeneous distributed deployments (parties on
+# different backends) MUST use ``set_prf_impl("threefry")`` (backend-
+# deterministic); the distributed runtime enforces backend homogeneity
+# otherwise.
 # ---------------------------------------------------------------------------
+
+_PRF_IMPL = "rbg"
+
+
+def set_prf_impl(name: str) -> None:
+    global _PRF_IMPL
+    assert name in ("rbg", "threefry")
+    _PRF_IMPL = name
 
 
 def _key_from_seed(seed_u32x4):
-    """Derive a threefry key from a uint32[4] seed deterministically."""
-    k = seed_u32x4.astype(jnp.uint32)
+    """Wrap a uint32[4] seed as a PRNG key of the active implementation."""
+    k = jnp.asarray(seed_u32x4, dtype=jnp.uint32)
+    if _PRF_IMPL == "rbg":
+        return jax.random.wrap_key_data(k, impl="rbg")
     data = (k[0].astype(U64) << np.uint64(32)) | k[1].astype(U64)
     data2 = (k[2].astype(U64) << np.uint64(32)) | k[3].astype(U64)
-    key = jax.random.key(data ^ (data2 * np.uint64(0x9E3779B97F4A7C15)))
-    return key
+    return jax.random.key(data ^ (data2 * np.uint64(0x9E3779B97F4A7C15)))
+
+
+def mix_seed(seed_u32x4, nonce_u32x4):
+    """Derive a fresh 128-bit seed from (key, public nonce) on device.
+
+    Replaces the reference's blake3 keyed hash (host/prim.rs:123).  One
+    Philox draw keyed by key^nonce-mix: distinct nonces index distinct
+    Philox counters, so derived seeds are computationally independent under
+    the PRF assumption on Philox/Threefry.
+    """
+    k = jnp.asarray(seed_u32x4, dtype=jnp.uint32)
+    n = jnp.asarray(nonce_u32x4, dtype=jnp.uint32)
+    mixed = k ^ (n * np.uint32(0x9E3779B9) + np.uint32(0x85EBCA6B))
+    key = _key_from_seed(mixed)
+    return jax.random.bits(key, (4,), dtype=jnp.uint32)
 
 
 def sample_uniform_seeded(shape, seed_u32x4, width: int):
@@ -198,11 +261,10 @@ def sample_uniform_seeded(shape, seed_u32x4, width: int):
     shape = tuple(int(s) for s in shape)
     if width == 64:
         return jax.random.bits(key, shape, dtype=U64), None
-    k1, k2 = jax.random.split(key)
-    return (
-        jax.random.bits(k1, shape, dtype=U64),
-        jax.random.bits(k2, shape, dtype=U64),
-    )
+    # one draw for both limbs (avoids key splits, which are expensive for
+    # non-rbg impls and needless here)
+    both = jax.random.bits(key, (2,) + shape, dtype=U64)
+    return both[1], both[0]
 
 
 def sample_bits_seeded(shape, seed_u32x4, width: int):
@@ -316,12 +378,38 @@ def _matmul_u64_limb_f32(a, b):
 def matmul(lo1, hi1, lo2, hi2):
     """Ring matmul (Dot).  For u64 the wrapping u64 dot is exact ring math.
     For u128 we decompose to 16-bit limbs, take exact u64 partial matmuls,
-    and recombine with 128-bit shifted adds."""
+    and recombine with 128-bit shifted adds.
+
+    Vector operands are promoted to matrices for the limb path (which needs
+    (m, k) @ (k, n)) and the unit axes squeezed from the result.
+    """
+    a_vec = lo1.ndim == 1
+    b_vec = lo2.ndim == 1
+    if a_vec:
+        lo1 = lo1[None, :]
+        hi1 = hi1[None, :] if hi1 is not None else None
+    if b_vec:
+        lo2 = lo2[:, None]
+        hi2 = hi2[:, None] if hi2 is not None else None
+
     if hi1 is None:
         if get_matmul_strategy() == "limb_f32":
-            return _matmul_u64_limb_f32(lo1, lo2), None
-        return _matmul_u64_native(lo1, lo2), None
-    return _matmul_u128(lo1, hi1, lo2, hi2)
+            lo, hi = _matmul_u64_limb_f32(lo1, lo2), None
+        else:
+            lo, hi = _matmul_u64_native(lo1, lo2), None
+    else:
+        lo, hi = _matmul_u128(lo1, hi1, lo2, hi2)
+
+    if a_vec and b_vec:
+        lo = lo[0, 0]
+        hi = hi[0, 0] if hi is not None else None
+    elif a_vec:
+        lo = lo[0]
+        hi = hi[0] if hi is not None else None
+    elif b_vec:
+        lo = lo[..., 0]
+        hi = hi[..., 0] if hi is not None else None
+    return lo, hi
 
 
 def _limbs16_128(lo, hi):

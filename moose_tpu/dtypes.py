@@ -82,13 +82,16 @@ bool_ = DType("bool", "bool", is_boolean=True)
 def fixed(integral_precision: int, fractional_precision: int) -> DType:
     """Fixed-point dtype backed by a ring chosen by total precision.
 
-    Mirrors the reference's ``pm.fixed(i, f)``: total bits ``i + f`` <= 27
-    selects the 64-bit ring, otherwise the 128-bit ring (the reference picks
-    Fixed64 vs Fixed128 explicitly via constants; we follow its predictor
-    default ``fixed(24, 40)`` -> Fixed128).
+    Mirrors the reference's ``pm.fixed(i, f)``.  The reference maps every
+    fixed dtype to the 128-bit ring (pymoose/src/computation.rs:682); we
+    instead select the 64-bit ring whenever all protocols still fit, which
+    halves limb count on TPU.  The binding constraint: a raw product has
+    magnitude < 2^{2(i+f)} and must satisfy trunc_pr's input bound
+    |x| < 2^{width-3} (additive trunc with sign bit and overflow-correction
+    slack), so ring64 requires ``2*(i+f) <= 61``.  Use ``fixed128(i, f)``
+    to force the wide ring.
     """
-    total = integral_precision + fractional_precision
-    if total <= 27:
+    if 2 * (integral_precision + fractional_precision) <= 61:
         name = "fixed64"
     else:
         name = "fixed128"

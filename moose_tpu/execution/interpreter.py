@@ -1,0 +1,243 @@
+"""Logical-computation interpreter: walks the IR and executes via the
+logical dialect, compiling the whole computation to ONE fused XLA program.
+
+This is the TPU-native replacement for the reference's per-op async executor
+(``moose/src/execution/asynchronous.rs``): instead of spawning one task per
+operation and letting tokio schedule, the entire dataflow graph is traced
+through the dialect kernels under ``jax.jit`` and XLA schedules/fuses it.
+Host boundaries (Input/Load/Save/Output) are resolved outside the jitted
+core; everything numeric happens on device.
+
+Computations containing dynamic-shape ops (Select) fall back to eager
+execution — XLA requires static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from .. import dtypes as dt
+from ..computation import Computation, HostPlacement
+from ..dialects import logical
+from ..values import (
+    HostBitTensor,
+    HostFixedTensor,
+    HostRingTensor,
+    HostShape,
+    HostString,
+    HostTensor,
+    HostUnit,
+    host_tensor_from_numpy,
+    to_numpy,
+)
+from .session import EagerSession
+
+_DYNAMIC_SHAPE_KINDS = frozenset({"Select"})
+
+# Kinds resolved at the host boundary rather than by the logical dialect.
+_BOUNDARY_KINDS = frozenset({"Input", "Load", "Save", "Output"})
+
+
+@dataclasses.dataclass
+class _Plan:
+    """Static execution plan for one (computation, binding) pair."""
+
+    comp: Computation
+    order: list[str]
+    static_env: dict[str, Any]  # op name -> static value (strings, scalars)
+    dynamic_names: list[str]  # Input/Load ops fed arrays at call time
+    use_jit: bool
+    core: Callable  # (master_key, dyn: dict[str, array]) -> (outputs, saves)
+
+
+def _is_static_scalar(ty_name: str) -> bool:
+    return ty_name in ("HostInt", "HostFloat", "HostString")
+
+
+def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
+    order = comp.toposort_names()
+    static_env: dict[str, Any] = {}
+    dynamic_names: list[str] = []
+
+    for name in order:
+        op = comp.operations[name]
+        plc = comp.placement_of(op)
+        if op.kind == "Input":
+            val = arguments.get(op.name)
+            if val is None:
+                raise ValueError(f"missing argument {op.name!r}")
+            if isinstance(val, str):
+                static_env[name] = HostString(val, plc.name)
+            elif isinstance(val, (int, float)) and _is_static_scalar(
+                op.signature.return_type.name
+            ):
+                static_env[name] = val
+            else:
+                dynamic_names.append(name)
+        elif op.kind == "Constant":
+            value = op.attributes["value"]
+            if isinstance(value, str):
+                static_env[name] = HostString(value, plc.name)
+            elif op.signature.return_type.name in ("HostInt", "HostFloat"):
+                static_env[name] = value
+        elif op.kind == "Load":
+            dynamic_names.append(name)
+
+    if any(
+        comp.operations[n].kind in _DYNAMIC_SHAPE_KINDS for n in order
+    ):
+        use_jit = False
+
+    def core(master_key, dyn: dict):
+        sess = EagerSession(master_key=master_key)
+        logical.bind_placements(sess, comp)
+        env: dict[str, Any] = {}
+        outputs: dict[str, Any] = {}
+        # dict keyed by (placement, storage key) so the returned structure is
+        # a valid jit output pytree (strings live in the keys = aux data)
+        saves: dict[tuple[str, str], Any] = {}
+        for name in order:
+            op = comp.operations[name]
+            plc = comp.placement_of(op)
+            if name in static_env:
+                env[name] = static_env[name]
+                continue
+            if op.kind in ("Input", "Load"):
+                arr = dyn[name]
+                env[name] = _lift_array(arr, op, plc.name)
+                continue
+            if op.kind == "Save":
+                key = env[op.inputs[0]]
+                assert isinstance(key, HostString), (
+                    f"Save key must be a string, found {type(key).__name__}"
+                )
+                value = logical.to_host(sess, plc.name, env[op.inputs[1]])
+                saves[(plc.name, key.value)] = value
+                env[name] = HostUnit(plc.name)
+                continue
+            if op.kind == "Output":
+                value = env[op.inputs[0]]
+                if not isinstance(value, HostUnit):
+                    value = logical.to_host(sess, plc.name, value)
+                env[name] = value
+                outputs[name] = value
+                continue
+            args = [env[i] for i in op.inputs]
+            env[name] = logical.execute_op(sess, comp, op, args)
+        return outputs, saves
+
+    return _Plan(comp, order, static_env, dynamic_names, use_jit, core)
+
+
+def _lift_array(arr, op, plc_name: str):
+    """Bind a host-boundary array (possibly a jit tracer) as a runtime
+    value."""
+    import jax.numpy as jnp
+
+    ret = op.signature.return_type
+    dtype = ret.dtype
+    if dtype is not None and dtype.is_fixedpoint:
+        raise ValueError(
+            f"op {op.name}: fixed-point host inputs must be loaded as floats "
+            "and cast"
+        )
+    if dtype is not None and dtype.is_boolean:
+        return HostBitTensor(jnp.asarray(arr).astype(jnp.uint8), plc_name)
+    if dtype is not None:
+        return HostTensor(
+            jnp.asarray(arr).astype(np.dtype(dtype.numpy_name)),
+            plc_name,
+            dtype,
+        )
+    if isinstance(arr, np.ndarray):
+        return host_tensor_from_numpy(arr, plc_name)
+    return HostTensor(jnp.asarray(arr), plc_name, dt.from_numpy(arr.dtype))
+
+
+class Interpreter:
+    """Caches compiled plans per (computation, binding signature)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def evaluate(
+        self,
+        comp: Computation,
+        storage: dict,
+        arguments: Optional[dict] = None,
+        use_jit: bool = True,
+    ) -> dict:
+        arguments = arguments or {}
+        cache_key = self._cache_key(comp, arguments, use_jit)
+        cached = self._cache.get(cache_key)
+        if cached is None:
+            plan = build_plan(comp, arguments, use_jit)
+            fn = jax.jit(plan.core) if plan.use_jit else plan.core
+            self._cache[cache_key] = (plan, fn)
+        else:
+            plan, fn = cached
+
+        dyn = {}
+        for name in plan.dynamic_names:
+            op = comp.operations[name]
+            plc = comp.placement_of(op)
+            if op.kind == "Input":
+                dyn[name] = np.asarray(arguments[name])
+            else:  # Load
+                key = self._resolve_load_key(plan, comp, op, arguments)
+                store = storage.get(plc.name, {})
+                if key not in store:
+                    raise KeyError(
+                        f"no value for key {key!r} in storage of "
+                        f"{plc.name!r}"
+                    )
+                dyn[name] = np.asarray(store[key])
+
+        master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
+        outputs, saves = fn(master_key, dyn)
+
+        for (plc_name, key), value in saves.items():
+            storage.setdefault(plc_name, {})[key] = _to_user_value(value)
+        return {
+            name: _to_user_value(value) for name, value in outputs.items()
+        }
+
+    def _resolve_load_key(self, plan, comp, op, arguments) -> str:
+        key_val = plan.static_env.get(op.inputs[0])
+        if isinstance(key_val, HostString):
+            return key_val.value
+        raise ValueError(
+            f"Load {op.name}: key must be statically resolvable "
+            "(a string constant or string argument)"
+        )
+
+    def _cache_key(self, comp, arguments, use_jit):
+        parts = [id(comp), use_jit]
+        for name, val in sorted(arguments.items()):
+            if isinstance(val, (str, int, float)):
+                parts.append((name, val))
+            else:
+                arr = np.asarray(val)
+                parts.append((name, arr.shape, str(arr.dtype)))
+        return tuple(parts)
+
+
+def _to_user_value(value):
+    """Convert a runtime value to the user-facing Python/numpy form."""
+    if isinstance(value, HostUnit):
+        return None
+    if isinstance(value, HostFixedTensor):
+        # decode plaintext fixed tensors for the user (documented deviation:
+        # the reference returns the raw fixed value; floats are friendlier
+        # and lossless for the precisions in use)
+        from ..dialects import host as host_ops
+
+        return np.asarray(
+            to_numpy(host_ops.fixedpoint_decode(value, value.plc))
+        )
+    return to_numpy(value)

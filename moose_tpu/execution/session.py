@@ -75,9 +75,8 @@ class EagerSession:
 
         idx = self._key_counter
         self._key_counter += 1
-        k = ring._key_from_seed(self._master)
-        k = jax.random.fold_in(k, np.uint32(idx))
-        return HostPrfKey(jax.random.bits(k, (4,), dtype=jnp.uint32), plc)
+        nonce = np.array([idx, 0x6B657921, idx ^ 0xDEADBEEF, 1], np.uint32)
+        return HostPrfKey(ring.mix_seed(self._master, nonce), plc)
 
     def derive_seed(self, plc: str, key: HostPrfKey, sync_key: bytes) -> HostSeed:
         return host.derive_seed(key, sync_key, plc)

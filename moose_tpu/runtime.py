@@ -1,0 +1,123 @@
+"""User-facing runtimes.
+
+API-compatible re-design of the reference's runtime wrappers
+(``pymoose/pymoose/runtime.py`` + ``pymoose/src/bindings.rs``):
+
+- ``LocalMooseRuntime``: several virtual hosts in one process with dict
+  storage; the whole computation compiles to a single XLA program (the
+  reference instead spins up one async executor per identity over an
+  in-memory fake network).
+- ``GrpcMooseRuntime``: drives remote workers over gRPC choreography (see
+  ``moose_tpu/distributed/``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .computation import Computation
+from .edsl import base as edsl_base
+from .edsl import tracer
+from .execution.interpreter import Interpreter
+
+
+def _lift_computation(computation, arguments):
+    if isinstance(computation, edsl_base.AbstractComputation):
+        computation = tracer.trace(computation)
+    if not isinstance(computation, Computation):
+        raise ValueError(
+            "`computation` must be an AbstractComputation or Computation, "
+            f"found {type(computation)}"
+        )
+    return computation, dict(arguments or {})
+
+
+class LocalMooseRuntime:
+    def __init__(
+        self,
+        identities: List[str],
+        storage_mapping: Optional[Dict[str, Dict]] = None,
+        use_jit: Optional[bool] = None,
+    ):
+        import os
+
+        if use_jit is None:
+            use_jit = os.environ.get("MOOSE_TPU_JIT", "1") != "0"
+        self.use_jit = use_jit
+        storage_mapping = storage_mapping or {}
+        for identity in storage_mapping:
+            if identity not in identities:
+                raise ValueError(
+                    f"unknown identity {identity} in `storage_mapping`, "
+                    f"must be one of {identities}"
+                )
+        self.identities = list(identities)
+        self.storage = {
+            identity: dict(storage_mapping.get(identity, {}))
+            for identity in identities
+        }
+        self._interpreter = Interpreter()
+        # traced-IR cache so repeated evaluations of the same
+        # AbstractComputation reuse the compiled XLA executable
+        self._trace_cache: dict[int, Computation] = {}
+
+    def set_default(self):
+        edsl_base.set_current_runtime(self)
+
+    def evaluate_computation(
+        self,
+        computation,
+        arguments=None,
+        compiler_passes=None,
+    ):
+        if isinstance(computation, edsl_base.AbstractComputation):
+            key = id(computation)
+            traced = self._trace_cache.get(key)
+            if traced is None:
+                traced = tracer.trace(computation)
+                self._trace_cache[key] = traced
+            computation = traced
+        computation, arguments = _lift_computation(computation, arguments)
+        return self._interpreter.evaluate(
+            computation, self.storage, arguments, use_jit=self.use_jit
+        )
+
+    def evaluate_compiled(self, comp_bin, arguments=None):
+        from .serde import deserialize_computation
+
+        comp = deserialize_computation(comp_bin)
+        return self.evaluate_computation(comp, arguments)
+
+    def read_value_from_storage(self, identity: str, key: str):
+        return self.storage[identity][key]
+
+    def write_value_to_storage(self, identity: str, key: str, value):
+        if identity not in self.storage:
+            raise ValueError(f"unknown identity {identity}")
+        self.storage[identity][key] = value
+        return value
+
+
+class GrpcMooseRuntime:
+    """Client runtime for a cluster of gRPC workers (reference
+    GrpcMooseRuntime, execution/grpc.rs:11-146)."""
+
+    def __init__(self, identities: Dict):
+        self.identities = {
+            (
+                role.name
+                if isinstance(role, edsl_base.HostPlacementExpression)
+                else role
+            ): addr
+            for role, addr in identities.items()
+        }
+        from .distributed.client import GrpcClientRuntime
+
+        self._client = GrpcClientRuntime(self.identities)
+
+    def set_default(self):
+        edsl_base.set_current_runtime(self)
+
+    def evaluate_computation(self, computation, arguments=None):
+        computation, arguments = _lift_computation(computation, arguments)
+        return self._client.run_computation(computation, arguments)

@@ -7,6 +7,11 @@ import os
 # plugin at interpreter startup, so JAX_PLATFORMS in os.environ is read too
 # early to override from here — use jax.config instead (backends are not yet
 # initialized when conftest loads).
+# Default to eager per-op execution in tests (reference SyncSession
+# behavior): whole-computation XLA compiles are exercised by dedicated
+# jit tests and by bench.py on real TPU hardware.
+os.environ.setdefault("MOOSE_TPU_JIT", "0")
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

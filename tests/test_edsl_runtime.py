@@ -1,0 +1,214 @@
+"""End-to-end eDSL -> trace -> interpret tests.
+
+Modeled on the reference's rust_integration_tests/*.py: build a
+@pm.computation over alice/bob/carole (+ replicated), run it under
+LocalMooseRuntime, compare against numpy within fixed-point tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def test_host_only_add_via_storage():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(x_uri: pm.Argument(placement=alice, vtype=pm.StringType())):
+        with alice:
+            x = pm.load(x_uri, dtype=pm.float64)
+            y = pm.constant(np.array([1.0, 2.0, 3.0]), dtype=pm.float64)
+            z = x + y
+            res = pm.save("z", z)
+        return res
+
+    runtime = LocalMooseRuntime(
+        ["alice", "bob", "carole"],
+        storage_mapping={"alice": {"x": np.array([10.0, 20.0, 30.0])}},
+    )
+    runtime.evaluate_computation(comp, arguments={"x_uri": "x"})
+    result = runtime.read_value_from_storage("alice", "z")
+    np.testing.assert_allclose(result, [11.0, 22.0, 33.0])
+
+
+def test_host_argument_array_and_output():
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            y = x * x
+        return y
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    outs = runtime.evaluate_computation(
+        comp, arguments={"x": np.array([1.0, -2.0, 3.0])}
+    )
+    (val,) = outs.values()
+    np.testing.assert_allclose(val, [1.0, 4.0, 9.0])
+
+
+def test_replicated_dot_sigmoid_logreg():
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with bob:
+            w_f = pm.cast(w, dtype=fx_dtype)
+        with rep:
+            y = pm.sigmoid(pm.dot(x_f, w_f))
+        with carole:
+            y_host = pm.cast(y, dtype=pm.float64)
+            res = pm.save("y", y_host)
+        return res
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 3)) * 0.5
+    w = rng.normal(size=(3,)) * 0.5
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    runtime.evaluate_computation(comp, arguments={"x": x, "w": w})
+    got = runtime.read_value_from_storage("carole", "y")
+    want = 1.0 / (1.0 + np.exp(-(x @ w)))
+    np.testing.assert_allclose(got, want, atol=1e-2)
+
+
+def test_replicated_softmax_matches_numpy():
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(x_uri: pm.Argument(placement=bob, vtype=pm.StringType())):
+        with bob:
+            x = pm.load(x_uri, dtype=pm.float64)
+            x_fixed = pm.cast(x, dtype=fx_dtype)
+        with rep:
+            x_soft = pm.softmax(x_fixed, axis=1, upmost_index=3)
+        with bob:
+            x_soft_host = pm.cast(x_soft, dtype=pm.float64)
+            res = pm.save("softmax", x_soft_host)
+        return res
+
+    x = np.array(
+        [[-1.38, 3.65, -1.56], [-1.38, 3.65, -1.8], [-0.64, 0.76, 0.97]]
+    )
+    runtime = LocalMooseRuntime(
+        ["alice", "bob", "carole"], storage_mapping={"bob": {"x_arg": x}}
+    )
+    runtime.evaluate_computation(comp, arguments={"x_uri": "x_arg"})
+    got = runtime.read_value_from_storage("bob", "softmax")
+    ex = np.exp(x - x.max(axis=1, keepdims=True))
+    want = ex / ex.sum(axis=1, keepdims=True)
+    # decimal=2 tolerance, matching the reference's softmax_test.py:14-50
+    np.testing.assert_allclose(got, want, atol=1.5e-2)
+
+
+def test_replicated_mux_less():
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        y: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with bob:
+            y_f = pm.cast(y, dtype=fx_dtype)
+        with rep:
+            sel = pm.less(x_f, y_f)
+            z = pm.mux(sel, y_f, x_f)  # max(x, y)
+        with carole:
+            z_host = pm.cast(z, dtype=pm.float64)
+        return z_host
+
+    x = np.array([1.0, 5.0, -3.0])
+    y = np.array([2.0, 4.0, -4.0])
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    outs = runtime.evaluate_computation(comp, arguments={"x": x, "y": y})
+    (got,) = outs.values()
+    np.testing.assert_allclose(got, np.maximum(x, y), atol=1e-6)
+
+
+def test_mirrored_constant_mul():
+    alice, bob, carole, rep = _players()
+    mir = pm.mirrored_placement("mir", players=[alice, bob, carole])
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with mir:
+            c = pm.constant(np.array([2.0, 0.5, -1.0]), dtype=fx_dtype)
+        with rep:
+            y = pm.mul(x_f, c)
+        with alice:
+            y_host = pm.cast(y, dtype=pm.float64)
+        return y_host
+
+    x = np.array([3.0, 8.0, 5.0])
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    outs = runtime.evaluate_computation(comp, arguments={"x": x})
+    (got,) = outs.values()
+    np.testing.assert_allclose(got, x * np.array([2.0, 0.5, -1.0]), atol=1e-6)
+
+
+def test_select_dynamic_eager():
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            mask = pm.constant(
+                np.array([True, False, True]), dtype=pm.bool_
+            )
+            y = pm.select(x, 0, mask)
+        return y
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    outs = runtime.evaluate_computation(
+        comp, arguments={"x": np.array([1.0, 2.0, 3.0])}
+    )
+    (got,) = outs.values()
+    np.testing.assert_allclose(got, [1.0, 3.0])
+
+
+def test_jit_cache_reuse_fresh_randomness():
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with rep:
+            y = pm.mul(x_f, x_f)
+        with alice:
+            y_host = pm.cast(y, dtype=pm.float64)
+        return y_host
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
+    for val in ([1.0, 2.0], [3.0, 4.0]):
+        outs = runtime.evaluate_computation(
+            comp, arguments={"x": np.array(val)}
+        )
+        (got,) = outs.values()
+        np.testing.assert_allclose(got, np.square(val), atol=1e-6)
