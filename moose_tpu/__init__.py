@@ -112,24 +112,24 @@ __version__ = "0.1.0"
 
 def __getattr__(name):
     # Lazy imports of heavier subsystems to keep `import moose_tpu` light.
-    if name in ("LocalMooseRuntime", "GrpcMooseRuntime"):
-        from . import runtime
+    lazy = {
+        "LocalMooseRuntime": ("runtime", "LocalMooseRuntime"),
+        "GrpcMooseRuntime": ("runtime", "GrpcMooseRuntime"),
+        "runtime": ("runtime", None),
+        "predictors": ("predictors", None),
+        "elk_compiler": ("elk_compiler", None),
+        "parallel": ("parallel", None),
+    }
+    if name in lazy:
+        import importlib
 
-        return getattr(runtime, name)
-    if name == "runtime":
-        from . import runtime
-
-        return runtime
-    if name == "predictors":
-        from . import predictors
-
-        return predictors
-    if name == "elk_compiler":
-        from . import elk_compiler
-
-        return elk_compiler
-    if name == "testing":
-        from . import testing
-
-        return testing
+        mod_name, attr = lazy[name]
+        try:
+            mod = importlib.import_module(f".{mod_name}", __name__)
+        except ModuleNotFoundError as e:
+            # keep hasattr()-style feature detection working
+            raise AttributeError(
+                f"module 'moose_tpu' has no attribute {name!r} ({e})"
+            ) from e
+        return mod if attr is None else getattr(mod, attr)
     raise AttributeError(f"module 'moose_tpu' has no attribute {name!r}")

@@ -202,15 +202,10 @@ def _mirrored_to_public_ring(v):
 # ---------------------------------------------------------------------------
 
 
-_STRUCTURAL_SESS_METHOD = {
-    "Reshape": "reshape",
-    "ExpandDims": "expand_dims",
-    "Squeeze": "squeeze",
-    "Transpose": "transpose",
-    "IndexAxis": "index_axis",
-    "AtLeast2D": "at_least_2d",
-    "Broadcast": "broadcast",
-}
+_HOST_STRUCTURAL_KINDS = frozenset(
+    {"Reshape", "ExpandDims", "Squeeze", "Transpose", "IndexAxis",
+     "AtLeast2D", "Broadcast"}
+)
 
 _REP_STRUCTURAL = {
     "Reshape": rep_ops.reshape,
@@ -344,7 +339,9 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         x = to_host(sess, h, args[1])
         y = to_host(sess, h, args[2])
         if isinstance(x, HostFixedTensor):
-            sel = s.value.astype(x.tensor.lo.dtype)
+            assert isinstance(y, HostFixedTensor), (
+                f"Mux branches must both be fixed, found {type(y).__name__}"
+            )
             import jax.numpy as jnp
 
             lo = jnp.where(s.value != 0, x.tensor.lo, y.tensor.lo)
@@ -427,7 +424,7 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
             )
         return sess.concat(h, vals, axis)
 
-    if kind in _STRUCTURAL_SESS_METHOD:
+    if kind in _HOST_STRUCTURAL_KINDS:
         return _host_structural(sess, comp, op, h, args)
 
     if kind == "Slice":
@@ -473,7 +470,18 @@ def _cast_on_host(sess, h, v, target: dt.DType):
     v = to_host(sess, h, v)
     if target.is_fixedpoint:
         if isinstance(v, HostFixedTensor):
-            return v
+            # fixed -> fixed precision move: rescale the raw ring value
+            df = target.fractional_precision - v.fractional_precision
+            t = v.tensor
+            if df > 0:
+                t = host.ring_shl(t, df, h)
+            elif df < 0:
+                t = host.ring_shr_arith(t, -df, h)
+            return HostFixedTensor(
+                t,
+                target.integral_precision,
+                target.fractional_precision,
+            )
         assert isinstance(v, HostTensor)
         return host.fixedpoint_encode(
             v,
@@ -563,6 +571,20 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
 
     if kind == "Identity":
         return to_rep(sess, rep, args[0])
+
+    if kind == "Constant":
+        # build the host constant on owners[0] then share (scalar operator
+        # sugar like `y + 1.0` inside `with rep:` lands here)
+        host_op = Operation(
+            name=op.name,
+            kind="Constant",
+            inputs=[],
+            placement_name=rep.owners[0],
+            signature=op.signature,
+            attributes=op.attributes,
+        )
+        h = _constant_on_host(sess, rep.owners[0], host_op)
+        return to_rep(sess, rep, h)
 
     if kind in ("Add", "Sub", "Mul", "Dot", "Div"):
         x, y = args

@@ -1,0 +1,1 @@
+from . import spmd  # noqa: F401

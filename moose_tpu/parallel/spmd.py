@@ -1,0 +1,507 @@
+"""Party-stacked SPMD execution of the 3-party replicated protocol.
+
+This is the TPU-native execution layout for single-controller deployments
+(one XLA program spanning the pod): instead of six separately-labelled
+per-party arrays (the lowering-friendly layout in ``dialects/replicated.py``),
+a replicated sharing is ONE array with leading axes ``(party=3, slot=2)``.
+
+- Share-local kernels are single vectorized ops over the party axis.
+- Cross-party share movement (resharing after multiplication) is
+  ``jnp.roll`` over the party axis — XLA lowers it to ``collective-permute``
+  over ICI when the axis is sharded on a device mesh.
+- The party axis rides a named mesh axis (``parties``), with additional
+  mesh axes sharding the data dimensions (batch) — the analogue of the
+  reference's 3 workers exchanging shares over gRPC
+  (``replicated/arith.rs:317-367``; networking backends, SURVEY §5), with
+  ICI collectives instead of the network.
+
+Sharing convention matches ``dialects/replicated.py``: x = x0+x1+x2, party i
+holds the pair (x_i, x_{i+1}); ``lo[i, 0]`` is x_i, ``lo[i, 1]`` is
+x_{i+1}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dialects import ring
+
+U64 = jnp.uint64
+
+
+@dataclasses.dataclass
+class SpmdRep:
+    """Party-stacked replicated ring tensor: arrays (3, 2, *shape)."""
+
+    lo: jax.Array
+    hi: Optional[jax.Array]
+    width: int
+
+    @property
+    def shape(self):
+        return self.lo.shape[2:]
+
+
+jax.tree_util.register_pytree_node(
+    SpmdRep,
+    lambda v: ((v.lo, v.hi), (v.width,)),
+    lambda aux, ch: SpmdRep(ch[0], ch[1], aux[0]),
+)
+
+
+@dataclasses.dataclass
+class SpmdFixed:
+    tensor: SpmdRep
+    integral_precision: int
+    fractional_precision: int
+
+
+jax.tree_util.register_pytree_node(
+    SpmdFixed,
+    lambda v: ((v.tensor,), (v.integral_precision, v.fractional_precision)),
+    lambda aux, ch: SpmdFixed(ch[0], aux[0], aux[1]),
+)
+
+
+# ---------------------------------------------------------------------------
+# Session: seed bank + counter for on-device PRF draws
+# ---------------------------------------------------------------------------
+
+
+class SpmdSession:
+    """Derives all per-invocation randomness from one master key.
+
+    In stacked mode each ``sample`` produces the whole (3, ...) party bank
+    in one RngBitGenerator call.  Party i's slice is exactly the stream it
+    would derive from pairwise PRF keys in the per-host layout; sharding the
+    leading axis over the party mesh axis keeps each slice resident on its
+    party's devices.
+    """
+
+    def __init__(self, master_key):
+        self._master = jnp.asarray(master_key, dtype=jnp.uint32)
+        self._counter = 0
+
+    def _next_seed(self) -> jax.Array:
+        idx = self._counter
+        self._counter += 1
+        nonce = np.array(
+            [idx & 0xFFFFFFFF, 0x5B3D9E21, idx ^ 0xA5A5A5A5, 7], np.uint32
+        )
+        return ring.mix_seed(self._master, nonce)
+
+    def sample_bank(self, shape, width: int):
+        """(3, *shape) uniform ring elements, one per party."""
+        seed = self._next_seed()
+        lo, hi = ring.sample_uniform_seeded((3,) + tuple(shape), seed, width)
+        return lo, hi
+
+    def sample(self, shape, width: int):
+        seed = self._next_seed()
+        return ring.sample_uniform_seeded(tuple(shape), seed, width)
+
+
+# ---------------------------------------------------------------------------
+# Core protocol
+# ---------------------------------------------------------------------------
+
+
+def _pairs(z_lo, z_hi, width):
+    """Stack per-party values z_i into the pair layout (z_i, z_{i+1})."""
+    lo = jnp.stack([z_lo, jnp.roll(z_lo, -1, axis=0)], axis=1)
+    hi = (
+        jnp.stack([z_hi, jnp.roll(z_hi, -1, axis=0)], axis=1)
+        if z_hi is not None
+        else None
+    )
+    return SpmdRep(lo, hi, width)
+
+
+def share(sess: SpmdSession, x_lo, x_hi, width: int) -> SpmdRep:
+    """Share a plaintext ring tensor: x0, x1 ~ PRF, x2 = x - x0 - x1."""
+    r_lo, r_hi = sess.sample_bank(x_lo.shape, width)
+    # stack [x0, x1, x2] with x2 = x - x0 - x1
+    s_lo, s_hi = ring.sub(x_lo, x_hi, r_lo[0], None if r_hi is None else r_hi[0])
+    s_lo, s_hi = ring.sub(s_lo, s_hi, r_lo[1], None if r_hi is None else r_hi[1])
+    z_lo = jnp.stack([r_lo[0], r_lo[1], s_lo], axis=0)
+    z_hi = (
+        jnp.stack([r_hi[0], r_hi[1], s_hi], axis=0)
+        if x_hi is not None
+        else None
+    )
+    return _pairs(z_lo, z_hi, width)
+
+
+def reveal(x: SpmdRep):
+    """Reconstruct the plaintext: sum over parties of first-slot shares."""
+    lo, hi = x.lo[0, 0], None if x.hi is None else x.hi[0, 0]
+    for i in (1, 2):
+        lo, hi = ring.add(
+            lo, hi, x.lo[i, 0], None if x.hi is None else x.hi[i, 0]
+        )
+    return lo, hi
+
+
+def add(x: SpmdRep, y: SpmdRep) -> SpmdRep:
+    lo, hi = ring.add(x.lo, x.hi, y.lo, y.hi)
+    return SpmdRep(lo, hi, x.width)
+
+
+def sub(x: SpmdRep, y: SpmdRep) -> SpmdRep:
+    lo, hi = ring.sub(x.lo, x.hi, y.lo, y.hi)
+    return SpmdRep(lo, hi, x.width)
+
+
+def neg(x: SpmdRep) -> SpmdRep:
+    lo, hi = ring.neg(x.lo, x.hi)
+    return SpmdRep(lo, hi, x.width)
+
+
+def shl(x: SpmdRep, amount: int) -> SpmdRep:
+    lo, hi = ring.shl(x.lo, x.hi, amount)
+    return SpmdRep(lo, hi, x.width)
+
+
+def zero_share(sess: SpmdSession, shape, width: int):
+    """alpha_i = PRF_i - PRF_{i+1}; one bank draw, sums to zero."""
+    s_lo, s_hi = sess.sample_bank(shape, width)
+    n_lo = jnp.roll(s_lo, -1, axis=0)
+    n_hi = jnp.roll(s_hi, -1, axis=0) if s_hi is not None else None
+    return ring.sub(s_lo, s_hi, n_lo, n_hi)
+
+
+def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
+    """v_i = f(x_i, y_i) + f(x_i, y_{i+1}) + f(x_{i+1}, y_i), per party."""
+
+    def take(t, slot):
+        return (
+            t.lo[:, slot],
+            None if t.hi is None else t.hi[:, slot],
+        )
+
+    x0, y0 = take(x, 0), take(y, 0)
+    x1, y1 = take(x, 1), take(y, 1)
+    v_lo, v_hi = contract(*x0, *y0)
+    t_lo, t_hi = contract(*x0, *y1)
+    v_lo, v_hi = ring.add(v_lo, v_hi, t_lo, t_hi)
+    t_lo, t_hi = contract(*x1, *y0)
+    return ring.add(v_lo, v_hi, t_lo, t_hi)
+
+
+def _reshare(sess, v_lo, v_hi, width):
+    a_lo, a_hi = zero_share(sess, v_lo.shape[1:], width)
+    z_lo, z_hi = ring.add(v_lo, v_hi, a_lo, a_hi)
+    return _pairs(z_lo, z_hi, width)
+
+
+def mul(sess: SpmdSession, x: SpmdRep, y: SpmdRep) -> SpmdRep:
+    v_lo, v_hi = _cross_terms(x, y, ring.mul)
+    return _reshare(sess, v_lo, v_hi, x.width)
+
+
+def dot(sess: SpmdSession, x: SpmdRep, y: SpmdRep) -> SpmdRep:
+    """Party-batched secure matmul: three vmapped ring matmuls + reshare.
+
+    The limb-decomposed MXU path in ``ring.matmul`` vmaps cleanly over the
+    party axis, so the 3 parties' local contractions run as one batched
+    MXU program."""
+
+    def contract(a_lo, a_hi, b_lo, b_hi):
+        if a_hi is None:
+            f = jax.vmap(lambda p, q: ring.matmul(p, None, q, None)[0])
+            return f(a_lo, b_lo), None
+        f = jax.vmap(
+            lambda p, ph, q, qh: ring.matmul(p, ph, q, qh)
+        )
+        return f(a_lo, a_hi, b_lo, b_hi)
+
+    v_lo, v_hi = _cross_terms(x, y, contract)
+    return _reshare(sess, v_lo, v_hi, x.width)
+
+
+def mul_public(x: SpmdRep, c_lo, c_hi) -> SpmdRep:
+    """x * public constant (same value on every party)."""
+    lo, hi = ring.mul(x.lo, x.hi, c_lo, c_hi)
+    return SpmdRep(lo, hi, x.width)
+
+
+def add_public(x: SpmdRep, c_lo, c_hi) -> SpmdRep:
+    """x + public c: only share x_0 (held at [0,0] and [2,1]) is adjusted."""
+    lo, hi = x.lo, x.hi
+    s_lo, s_hi = ring.add(lo[0, 0], None if hi is None else hi[0, 0], c_lo, c_hi)
+    lo = lo.at[0, 0].set(s_lo)
+    t_lo, t_hi = ring.add(
+        x.lo[2, 1], None if hi is None else x.hi[2, 1], c_lo, c_hi
+    )
+    lo = lo.at[2, 1].set(t_lo)
+    if hi is not None:
+        hi = hi.at[0, 0].set(s_hi).at[2, 1].set(t_hi)
+    return SpmdRep(lo, hi, x.width)
+
+
+def sub_public(x: SpmdRep, c_lo, c_hi) -> SpmdRep:
+    n_lo, n_hi = ring.neg(c_lo, c_hi)
+    return add_public(x, n_lo, n_hi)
+
+
+def public_sub(c_lo, c_hi, x: SpmdRep) -> SpmdRep:
+    return add_public(neg(x), c_lo, c_hi)
+
+
+# ---------------------------------------------------------------------------
+# Probabilistic truncation (stacked form of additive/trunc.rs:115-170 +
+# the PRF-compressed AdtToRep)
+# ---------------------------------------------------------------------------
+
+
+def trunc_pr(sess: SpmdSession, x: SpmdRep, amount: int) -> SpmdRep:
+    width = x.width
+    k = width - 1
+    shape = x.shape
+
+    def h(t, i, j):
+        return None if t is None else t[i, j]
+
+    # rep -> 2-party additive: a0 = x0 + x1 (party 0 holds both), a1 = x2.
+    a0_lo, a0_hi = ring.add(
+        x.lo[0, 0], h(x.hi, 0, 0), x.lo[0, 1], h(x.hi, 0, 1)
+    )
+    a1_lo, a1_hi = x.lo[1, 1], h(x.hi, 1, 1)
+
+    # provider (party 2) samples the masks and additively shares them
+    r_lo, r_hi = sess.sample(shape, width)
+    r_msb_lo, r_msb_hi = ring.shr(r_lo, r_hi, width - 1)
+    t_lo, t_hi = ring.shl(r_lo, r_hi, 1)
+    r_top_lo, r_top_hi = ring.shr(t_lo, t_hi, amount + 1)
+
+    def adt_share(v_lo, v_hi):
+        m_lo, m_hi = sess.sample(shape, width)
+        d_lo, d_hi = ring.sub(v_lo, v_hi, m_lo, m_hi)
+        return (m_lo, m_hi), (d_lo, d_hi)
+
+    (r0, r1) = adt_share(r_lo, r_hi)
+    (rt0, rt1) = adt_share(r_top_lo, r_top_hi)
+    (rm0, rm1) = adt_share(r_msb_lo, r_msb_hi)
+
+    ones_lo, ones_hi = ring.fill_like_shape(shape, width, 1)
+    up_lo, up_hi = ring.shl(ones_lo, ones_hi, k - 1)
+    down_lo, down_hi = ring.shl(ones_lo, ones_hi, k - amount - 1)
+
+    # x_positive = x + 2^(k-1); mask with r; reveal c
+    a0_lo, a0_hi = ring.add(a0_lo, a0_hi, up_lo, up_hi)
+    m0_lo, m0_hi = ring.add(a0_lo, a0_hi, r0[0], r0[1])
+    m1_lo, m1_hi = ring.add(a1_lo, a1_hi, r1[0], r1[1])
+    c_lo, c_hi = ring.add(m0_lo, m0_hi, m1_lo, m1_hi)
+
+    cns_lo, cns_hi = ring.shl(c_lo, c_hi, 1)
+    ctop_lo, ctop_hi = ring.shr(cns_lo, cns_hi, amount + 1)
+    cmsb_lo, cmsb_hi = ring.shr(c_lo, c_hi, width - 1)
+
+    # overflow = r_msb XOR c_msb, additively: rm + cmsb - 2*rm*cmsb
+    def adt_overflow(rm, first: bool):
+        p_lo, p_hi = ring.mul(rm[0], rm[1], cmsb_lo, cmsb_hi)
+        tw_lo, tw_hi = ring.shl(p_lo, p_hi, 1)
+        o_lo, o_hi = ring.sub(rm[0], rm[1], tw_lo, tw_hi)
+        if first:
+            o_lo, o_hi = ring.add(o_lo, o_hi, cmsb_lo, cmsb_hi)
+        return ring.shl(o_lo, o_hi, k - amount)
+
+    of0 = adt_overflow(rm0, True)
+    of1 = adt_overflow(rm1, False)
+
+    # y_positive = (c_top - r_top) + overflow ; y = y_positive - downshifter
+    y0_lo, y0_hi = ring.sub(ctop_lo, ctop_hi, rt0[0], rt0[1])
+    y0_lo, y0_hi = ring.add(y0_lo, y0_hi, of0[0], of0[1])
+    y0_lo, y0_hi = ring.sub(y0_lo, y0_hi, down_lo, down_hi)
+    y1_lo, y1_hi = ring.neg(rt1[0], rt1[1])
+    y1_lo, y1_hi = ring.add(y1_lo, y1_hi, of1[0], of1[1])
+
+    # additive -> replicated (PRF-compressed): z0 = PRF, z1 = y0 - z0, z2 = y1
+    z0_lo, z0_hi = sess.sample(shape, width)
+    z1_lo, z1_hi = ring.sub(y0_lo, y0_hi, z0_lo, z0_hi)
+    z_lo = jnp.stack([z0_lo, z1_lo, y1_lo], axis=0)
+    z_hi = (
+        jnp.stack([z0_hi, z1_hi, y1_hi], axis=0) if x.hi is not None else None
+    )
+    return _pairs(z_lo, z_hi, width)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-point layer
+# ---------------------------------------------------------------------------
+
+
+def fx_encode_share(sess, x_float, integ: int, frac: int, width: int):
+    lo, hi = ring.fixedpoint_encode(x_float, frac, width)
+    return SpmdFixed(share(sess, lo, hi, width), integ, frac)
+
+
+def fx_reveal_decode(x: SpmdFixed):
+    lo, hi = reveal(x.tensor)
+    return ring.fixedpoint_decode(lo, hi, x.fractional_precision)
+
+
+def fx_add(x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
+    return SpmdFixed(
+        add(x.tensor, y.tensor),
+        max(x.integral_precision, y.integral_precision),
+        x.fractional_precision,
+    )
+
+
+def fx_sub(x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
+    return SpmdFixed(
+        sub(x.tensor, y.tensor),
+        max(x.integral_precision, y.integral_precision),
+        x.fractional_precision,
+    )
+
+
+def fx_mul(sess, x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
+    z = mul(sess, x.tensor, y.tensor)
+    z = trunc_pr(sess, z, x.fractional_precision)
+    return SpmdFixed(
+        z,
+        max(x.integral_precision, y.integral_precision),
+        x.fractional_precision,
+    )
+
+
+def fx_dot(sess, x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
+    z = dot(sess, x.tensor, y.tensor)
+    z = trunc_pr(sess, z, x.fractional_precision)
+    return SpmdFixed(
+        z,
+        max(x.integral_precision, y.integral_precision),
+        x.fractional_precision,
+    )
+
+
+def fx_mul_public(sess, x: SpmdFixed, value: float) -> SpmdFixed:
+    raw = _fx_raw(value, x.fractional_precision, x.tensor.width)
+    c_lo, c_hi = ring.fill_like_shape((), x.tensor.width, raw)
+    z = mul_public(x.tensor, c_lo, c_hi)
+    z = trunc_pr(sess, z, x.fractional_precision)
+    return SpmdFixed(z, x.integral_precision, x.fractional_precision)
+
+
+def _fx_raw(value: float, frac: int, width: int) -> int:
+    return int(round(value * (1 << frac))) % (1 << width)
+
+
+def fx_add_public(x: SpmdFixed, value: float) -> SpmdFixed:
+    raw = _fx_raw(value, x.fractional_precision, x.tensor.width)
+    c_lo, c_hi = ring.fill_like_shape((), x.tensor.width, raw)
+    return SpmdFixed(
+        add_public(x.tensor, c_lo, c_hi),
+        x.integral_precision,
+        x.fractional_precision,
+    )
+
+
+def fx_transpose(x: SpmdFixed) -> SpmdFixed:
+    lo = jnp.swapaxes(x.tensor.lo, -1, -2)
+    hi = None if x.tensor.hi is None else jnp.swapaxes(x.tensor.hi, -1, -2)
+    return SpmdFixed(
+        SpmdRep(lo, hi, x.tensor.width),
+        x.integral_precision,
+        x.fractional_precision,
+    )
+
+
+def fx_mean_rows(sess, x: SpmdFixed) -> SpmdFixed:
+    """Mean over the leading data axis (axis 0 of the logical shape)."""
+    n = x.tensor.shape[0]
+    lo, hi = ring.sum_(x.tensor.lo, x.tensor.hi, axis=2)
+    summed = SpmdRep(lo, hi, x.tensor.width)
+    factor = _fx_raw(1.0 / n, x.fractional_precision, x.tensor.width)
+    c_lo, c_hi = ring.fill_like_shape((), x.tensor.width, factor)
+    z = mul_public(summed, c_lo, c_hi)
+    z = trunc_pr(sess, z, x.fractional_precision)
+    return SpmdFixed(z, x.integral_precision, x.fractional_precision)
+
+
+def fx_sigmoid_poly(sess, x: SpmdFixed) -> SpmdFixed:
+    """Degree-3 polynomial sigmoid approximation
+    sigma(t) ~ 0.5 + 0.198285*t - 0.004469*t^3 (least-squares on [-5, 5],
+    max error ~0.06) — the standard secure-logreg approximation; the exact
+    protocol sigmoid (exp + division) lives in ``dialects/fixedpoint.py``."""
+    x2 = fx_mul(sess, x, x)
+    x3 = fx_mul(sess, x2, x)
+    t1 = fx_mul_public(sess, x, 0.19828547)
+    t3 = fx_mul_public(sess, x3, -0.00446928)
+    return fx_add_public(fx_add(t1, t3), 0.5)
+
+
+# ---------------------------------------------------------------------------
+# Mesh helpers: shard the party axis + the batch axis
+# ---------------------------------------------------------------------------
+
+
+def make_mesh(n_devices: Optional[int] = None):
+    """Mesh with axes (parties, data): parties=3 when the device count
+    allows, else 1 (parties then co-located and data-parallel only)."""
+    devices = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devices)
+    p = 3 if n % 3 == 0 else 1
+    d = n // p
+    arr = np.array(devices).reshape(p, d)
+    return jax.sharding.Mesh(arr, ("parties", "data"))
+
+
+def rep_sharding(mesh, batch_axis: Optional[int] = 0, ndim: int = 2):
+    """PartitionSpec for a stacked share array (3, 2, *shape): party axis
+    over 'parties', one data axis over 'data'."""
+    P = jax.sharding.PartitionSpec
+    spec = ["parties", None] + [None] * ndim
+    if batch_axis is not None:
+        spec[2 + batch_axis] = "data"
+    return jax.sharding.NamedSharding(mesh, P(*spec))
+
+
+def constrain(x: SpmdRep, mesh, batch_axis=0) -> SpmdRep:
+    sh = rep_sharding(mesh, batch_axis, x.lo.ndim - 2)
+    lo = jax.lax.with_sharding_constraint(x.lo, sh)
+    hi = (
+        jax.lax.with_sharding_constraint(x.hi, sh)
+        if x.hi is not None
+        else None
+    )
+    return SpmdRep(lo, hi, x.width)
+
+
+# ---------------------------------------------------------------------------
+# Flagship computation: secure logistic-regression training step
+# (the reference's benchmark workload, benchmarks/pymoose/logreg.py)
+# ---------------------------------------------------------------------------
+
+
+def logreg_train_step(
+    sess: SpmdSession,
+    x: SpmdFixed,  # (batch, features)
+    y: SpmdFixed,  # (batch, 1)
+    w: SpmdFixed,  # (features, 1)
+    lr: float,
+    mesh=None,
+):
+    """One secure SGD step: w -= lr * X^T (sigmoid(Xw) - y) / batch."""
+    if mesh is not None:
+        x = SpmdFixed(
+            constrain(x.tensor, mesh, 0),
+            x.integral_precision,
+            x.fractional_precision,
+        )
+    logits = fx_dot(sess, x, w)  # (batch, 1)
+    preds = fx_sigmoid_poly(sess, logits)
+    err = fx_sub(preds, y)  # (batch, 1)
+    xt = fx_transpose(x)  # (features, batch)
+    grad = fx_dot(sess, xt, err)  # (features, 1)
+    n = x.tensor.shape[0]
+    step = fx_mul_public(sess, grad, lr / n)
+    return fx_sub(w, step)

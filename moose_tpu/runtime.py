@@ -56,10 +56,13 @@ class LocalMooseRuntime:
             identity: dict(storage_mapping.get(identity, {}))
             for identity in identities
         }
+        import weakref
+
         self._interpreter = Interpreter()
         # traced-IR cache so repeated evaluations of the same
-        # AbstractComputation reuse the compiled XLA executable
-        self._trace_cache: dict[int, Computation] = {}
+        # AbstractComputation reuse the compiled XLA executable; weak-keyed
+        # on the object itself (an id() key could be reused after GC)
+        self._trace_cache = weakref.WeakKeyDictionary()
 
     def set_default(self):
         edsl_base.set_current_runtime(self)
@@ -71,11 +74,10 @@ class LocalMooseRuntime:
         compiler_passes=None,
     ):
         if isinstance(computation, edsl_base.AbstractComputation):
-            key = id(computation)
-            traced = self._trace_cache.get(key)
+            traced = self._trace_cache.get(computation)
             if traced is None:
                 traced = tracer.trace(computation)
-                self._trace_cache[key] = traced
+                self._trace_cache[computation] = traced
             computation = traced
         computation, arguments = _lift_computation(computation, arguments)
         return self._interpreter.evaluate(
