@@ -253,10 +253,13 @@ class Operation:
             raise ValueError(f"unknown operator kind: {self.kind}")
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Computation:
     """A named dataflow graph (reference: NamedComputation,
-    computation.rs:1663-1666)."""
+    computation.rs:1663-1666).
+
+    Identity-based equality/hash so computations can key weak caches
+    (compiled-plan reuse) without structural comparison cost."""
 
     operations: dict[str, Operation] = dataclasses.field(default_factory=dict)
     placements: dict[str, Placement] = dataclasses.field(default_factory=dict)

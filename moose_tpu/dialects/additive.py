@@ -125,7 +125,13 @@ def trunc_pr(
     [-2^{k-2}, 2^{k-2}) (additive/trunc.rs:115-170): mask, reveal, shift
     in the clear, unmask, with an MSB-overflow correction term."""
     p0, p1 = adt.owners
-    assert provider not in (p0, p1)
+    if provider in (p0, p1):
+        from ..errors import KernelError
+
+        raise KernelError(
+            f"trunc provider {provider!r} must be a third party, not one of "
+            f"the additive owners {adt.owners}"
+        )
     width = x.shares[0].width
     k = width - 1
     shp = sess.shape(p0, x.shares[0])

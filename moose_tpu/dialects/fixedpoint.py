@@ -87,10 +87,13 @@ def sign_from_msb(sess, rep, msb_ring: RepTensor) -> RepTensor:
 
 
 def _assert_same_precision(x, y):
-    assert x.fractional_precision == y.fractional_precision, (
-        x.fractional_precision,
-        y.fractional_precision,
-    )
+    if x.fractional_precision != y.fractional_precision:
+        from ..errors import TypeMismatchError
+
+        raise TypeMismatchError(
+            "fixed-point operands disagree on fractional precision: "
+            f"{x.fractional_precision} vs {y.fractional_precision}"
+        )
 
 
 def add(sess, rep, x: RepFixedTensor, y: RepFixedTensor) -> RepFixedTensor:
@@ -300,7 +303,12 @@ def div(sess, rep, x: RepFixedTensor, y: RepFixedTensor) -> RepFixedTensor:
     f_p = x.fractional_precision
     k = i_p + f_p
     width = _width_of(x.tensor)
-    assert 2 * k <= width, (2 * k, width)
+    if 2 * k > width:
+        from ..errors import KernelError
+
+        raise KernelError(
+            f"division requires 2*(i+f) <= ring width, got 2*{k} > {width}"
+        )
     theta = max(1, math.ceil(math.log2(k / math.log2(17.0))))
 
     w = approximate_reciprocal(sess, rep, y.tensor, i_p, f_p)
@@ -501,7 +509,10 @@ def sigmoid(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
 def maximum_ring(sess, rep, xs: Sequence[RepTensor]) -> RepTensor:
     """Tournament max via less + mux (softmax.rs:10-54)."""
     n = len(xs)
-    assert n >= 1
+    if n < 1:
+        from ..errors import KernelError
+
+        raise KernelError("maximum requires at least one operand")
     if n == 1:
         return xs[0]
     a = maximum_ring(sess, rep, xs[: n // 2])

@@ -582,10 +582,15 @@ def fixedpoint_decode(
 def ring_fixedpoint_mean(
     x: HostRingTensor, axis, frac_precision: int, plc: str
 ) -> HostRingTensor:
-    """Fixed-point mean: sum then multiply by encode(1/n) then shift back
-    down (reference RingFixedpointMean).  Returns a value scaled by
-    2^(2*frac) relative... — we instead fold the division into a single
-    multiply by round(2^frac / n) and keep scale, then TruncPr elsewhere."""
+    """Fixed-point mean (reference RingFixedpointMean, host/ops.rs).
+
+    Sums over ``axis`` then multiplies by ``round(2^frac / n)``, folding the
+    division by n into one ring multiply.  CONTRACT: the result is scaled by
+    2^(2*frac) — i.e. one fixed-point scale too high — and every caller MUST
+    follow with a truncation by ``frac_precision`` (host shift-based trunc
+    on plaintext, TruncPr on shares) to restore the 2^frac scale.  This
+    matches the reference, whose RingFixedpointMean is likewise always
+    paired with a trunc in the fixedpoint dialect (fixedpoint/ops.rs)."""
     s = ring_sum(x, axis, plc)
     n = x.lo.shape[axis] if axis is not None else int(np.prod(x.lo.shape))
     factor = int(round((2.0 ** frac_precision) / n))

@@ -125,13 +125,23 @@ def bind_placements(sess, comp: Computation):
 
 def _rep_placement_of(sess, name: str) -> ReplicatedPlacement:
     plc = sess._placements[name]
-    assert isinstance(plc, ReplicatedPlacement)
+    if not isinstance(plc, ReplicatedPlacement):
+        from ..errors import TypeMismatchError
+
+        raise TypeMismatchError(
+            f"placement {name!r} is {type(plc).__name__}, expected Replicated"
+        )
     return plc
 
 
 def _mir_placement_of(sess, name: str) -> Mirrored3Placement:
     plc = sess._placements[name]
-    assert isinstance(plc, Mirrored3Placement)
+    if not isinstance(plc, Mirrored3Placement):
+        from ..errors import TypeMismatchError
+
+        raise TypeMismatchError(
+            f"placement {name!r} is {type(plc).__name__}, expected Mirrored3"
+        )
     return plc
 
 
@@ -141,7 +151,13 @@ def _mir_placement_of(sess, name: str) -> Mirrored3Placement:
 
 
 def _host_fixed_binop(sess, plc, x: HostFixedTensor, y: HostFixedTensor, op):
-    assert x.fractional_precision == y.fractional_precision
+    if x.fractional_precision != y.fractional_precision:
+        from ..errors import TypeMismatchError
+
+        raise TypeMismatchError(
+            "host fixed operands disagree on fractional precision: "
+            f"{x.fractional_precision} vs {y.fractional_precision}"
+        )
     f = x.fractional_precision
     i = max(x.integral_precision, y.integral_precision)
     a, b = x.tensor, y.tensor
@@ -537,16 +553,28 @@ def _host_structural(sess, comp, op, h, args):
     return out
 
 
+def decode_slice_spec(attributes) -> tuple:
+    """Rebuild the python slice tuple from Slice op attributes; the
+    ``"..."`` marker becomes a real Ellipsis so numpy/jnp expand it against
+    the operand's actual rank (see edsl.strided_slice)."""
+    if "slices" in attributes:
+        return tuple(
+            Ellipsis if s == "..." else slice(*s)
+            for s in attributes["slices"]
+        )
+    return (slice(attributes["begin"], attributes["end"]),)
+
+
 def _host_slice(sess, op, h, args):
     x = to_host(sess, h, args[0])
-    if "slices" in op.attributes:
-        spec = tuple(
-            slice(b, e, s) for (b, e, s) in op.attributes["slices"]
-        )
-    else:
-        spec = (slice(op.attributes["begin"], op.attributes["end"]),)
+    spec = decode_slice_spec(op.attributes)
     if isinstance(x, HostShape):
-        assert len(spec) == 1
+        if len(spec) != 1 or not isinstance(spec[0], slice):
+            from ..errors import KernelError
+
+            raise KernelError(
+                f"shape slicing takes a single slice, found {spec!r}"
+            )
         return HostShape(x.value[spec[0]], h)
     is_fixed = isinstance(x, HostFixedTensor)
     inner = x.tensor if is_fixed else x
@@ -681,12 +709,7 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
 
     if kind == "Slice":
         x = to_rep(sess, rep, args[0])
-        if "slices" in op.attributes:
-            spec = tuple(
-                slice(b, e, s) for (b, e, s) in op.attributes["slices"]
-            )
-        else:
-            spec = (slice(op.attributes["begin"], op.attributes["end"]),)
+        spec = decode_slice_spec(op.attributes)
         if isinstance(x, RepFixedTensor):
             out = rep_ops.strided_slice(sess, rep, x.tensor, spec)
             return RepFixedTensor(

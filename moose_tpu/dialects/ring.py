@@ -34,7 +34,12 @@ _MATMUL_STRATEGY: Optional[str] = None
 
 def set_matmul_strategy(name: Optional[str]) -> None:
     global _MATMUL_STRATEGY
-    assert name in (None, "native", "limb_f32")
+    if name not in (None, "native", "limb_f32"):
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            f"matmul strategy must be None, 'native' or 'limb_f32', got {name!r}"
+        )
     _MATMUL_STRATEGY = name
 
 
@@ -222,13 +227,51 @@ def equal_bits(lo1, hi1, lo2, hi2):
 # otherwise.
 # ---------------------------------------------------------------------------
 
-_PRF_IMPL = "rbg"
+import os as _os
+
+# Default: fast Philox ("rbg") for single-trust-domain local simulation;
+# "threefry" (a real reduced-Threefish PRF) for anything deployed across
+# trust domains.  Distributed runtimes call ``require_strong_prf()`` and
+# refuse to run on rbg unless MOOSE_TPU_ALLOW_WEAK_PRF=1 is set explicitly.
+_PRF_IMPL = _os.environ.get("MOOSE_TPU_PRF", "rbg")
+if _PRF_IMPL not in ("rbg", "threefry"):
+    raise ValueError(f"MOOSE_TPU_PRF must be 'rbg' or 'threefry', got {_PRF_IMPL!r}")
 
 
 def set_prf_impl(name: str) -> None:
     global _PRF_IMPL
-    assert name in ("rbg", "threefry")
+    if name not in ("rbg", "threefry"):
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            f"PRF impl must be 'rbg' or 'threefry', got {name!r}"
+        )
     _PRF_IMPL = name
+
+
+def get_prf_impl() -> str:
+    return _PRF_IMPL
+
+
+def require_strong_prf(context: str) -> None:
+    """Refuse the non-cryptographic default PRF outside local simulation.
+
+    The reference uses blake3 + AES-128-CTR everywhere (host/prim.rs:113);
+    our rbg default (Philox with a linear key/nonce mix) is fine when all
+    three parties live in one trust domain (one XLA program) but is an
+    unsafe source of share masks across genuinely distrusting parties.
+    """
+    if _PRF_IMPL == "rbg" and _os.environ.get(
+        "MOOSE_TPU_ALLOW_WEAK_PRF"
+    ) != "1":
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            f"{context} requires a cryptographic PRF: call "
+            "moose_tpu.dialects.ring.set_prf_impl('threefry') (or set "
+            "MOOSE_TPU_PRF=threefry); set MOOSE_TPU_ALLOW_WEAK_PRF=1 only "
+            "for testing"
+        )
 
 
 def _key_from_seed(seed_u32x4):

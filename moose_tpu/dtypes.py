@@ -79,6 +79,11 @@ uint64 = DType("uint64", "uint64", is_integer=True)
 bool_ = DType("bool", "bool", is_boolean=True)
 
 
+# Accumulation headroom bits reserved when auto-selecting ring64 (covers
+# reductions over up to 2^10 elements; see ``fixed``).
+_RING64_HEADROOM = 10
+
+
 def fixed(integral_precision: int, fractional_precision: int) -> DType:
     """Fixed-point dtype backed by a ring chosen by total precision.
 
@@ -88,10 +93,13 @@ def fixed(integral_precision: int, fractional_precision: int) -> DType:
     halves limb count on TPU.  The binding constraint: a raw product has
     magnitude < 2^{2(i+f)} and must satisfy trunc_pr's input bound
     |x| < 2^{width-3} (additive trunc with sign bit and overflow-correction
-    slack), so ring64 requires ``2*(i+f) <= 61``.  Use ``fixed128(i, f)``
-    to force the wide ring.
+    slack), so a single product needs ``2*(i+f) <= 61``.  Reductions (Dot,
+    Sum, AddN, Mean) accumulate up to log2(k) extra bits on top of that, so
+    we keep ``_RING64_HEADROOM`` bits of slack — ring64 is only chosen when
+    ``2*(i+f) + 10 <= 61``, safe for contractions over up to 2^10 = 1024
+    elements.  Use ``fixed64(i, f)`` / ``fixed128(i, f)`` to force a ring.
     """
-    if 2 * (integral_precision + fractional_precision) <= 61:
+    if 2 * (integral_precision + fractional_precision) + _RING64_HEADROOM <= 61:
         name = "fixed64"
     else:
         name = "fixed128"

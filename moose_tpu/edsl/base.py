@@ -159,18 +159,54 @@ class Expression:
     # -- operator sugar (reference edsl/base.py:146-258) -------------------
 
     def __getitem__(self, slice_spec):
-        if isinstance(slice_spec, slice):
+        # ShapeType slicing: shape[i:j] -> Sliced (reference base.py:170-187)
+        if isinstance(self.vtype, ty.ShapeType):
+            if isinstance(slice_spec, (tuple, list)):
+                if len(slice_spec) != 2:
+                    raise ValueError(
+                        "Indexing ShapeType requires a simple slice with "
+                        "only `start` & `stop` values."
+                    )
+                begin, end = slice_spec
+            elif isinstance(slice_spec, slice):
+                if slice_spec.step is not None:
+                    raise ValueError(
+                        "Indexing ShapeType requires a simple slice with "
+                        "only `start` & `stop` values."
+                    )
+                begin, end = slice_spec.start, slice_spec.stop
+            else:
+                raise IndexError(
+                    f"unsupported ShapeType slice spec {slice_spec!r}"
+                )
+            return sliced(self, begin, end, placement=self.placement)
+        if isinstance(slice_spec, slice) or slice_spec is Ellipsis:
             slice_spec = (slice_spec,)
-        if isinstance(slice_spec, tuple) and all(
-            isinstance(s, slice) for s in slice_spec
+        if isinstance(slice_spec, (tuple, list)) and all(
+            isinstance(s, slice) or s is Ellipsis for s in slice_spec
         ):
             return strided_slice(self, slice_spec, placement=self.placement)
         raise ValueError(f"unsupported slice spec {slice_spec!r}")
 
     def __neg__(self):
+        if (
+            isinstance(self.vtype, ty.TensorType)
+            and not self.vtype.dtype.is_signed
+        ):
+            raise TypeError(
+                f"Cannot negate Tensor of unsigned DType {self.vtype.dtype}."
+            )
         return neg(self, placement=self.placement)
 
     def __abs__(self):
+        if (
+            isinstance(self.vtype, ty.TensorType)
+            and not self.vtype.dtype.is_signed
+        ):
+            raise TypeError(
+                "Cannot take absolute value of Tensor of unsigned DType "
+                f"{self.vtype.dtype}."
+            )
         return abs(self, placement=self.placement)
 
     def __add__(self, other):
@@ -500,16 +536,34 @@ def select(x, axis, index, placement=None):
 
 
 def sliced(x, begin, end, placement=None):
-    assert isinstance(begin, int) and isinstance(end, int)
+    if not isinstance(begin, (int, type(None))) or not isinstance(
+        end, (int, type(None))
+    ):
+        raise TypeError(
+            f"slice bounds must be ints or None, found {begin!r}:{end!r}"
+        )
     placement = _materialize_placement_arg(placement)
     return _expr("Slice", [x], {"begin": begin, "end": end}, placement, x.vtype)
 
 
 def strided_slice(x, slices, placement=None):
+    """Multi-axis slice.  Entries may be ``slice`` objects or ``Ellipsis``;
+    Ellipsis is kept symbolic (encoded as ``"..."``) and expanded to the
+    right number of full slices by the kernel, where the operand rank is
+    known — rewriting it to a single ``slice(None)`` at trace time would
+    silently shift later axes (e.g. ``x[..., 0:1]`` on rank 3)."""
     placement = _materialize_placement_arg(placement)
-    assert all(isinstance(s, slice) for s in slices)
-    spec = tuple((s.start, s.stop, s.step) for s in slices)
-    return _expr("Slice", [x], {"slices": spec}, placement, x.vtype)
+    spec = []
+    for s in slices:
+        if s is Ellipsis:
+            spec.append("...")
+        elif isinstance(s, slice):
+            spec.append((s.start, s.stop, s.step))
+        else:
+            raise TypeError(f"unsupported slice entry {s!r}")
+    if spec.count("...") > 1:
+        raise ValueError("at most one Ellipsis is allowed in a slice spec")
+    return _expr("Slice", [x], {"slices": tuple(spec)}, placement, x.vtype)
 
 
 def transpose(x, placement=None):

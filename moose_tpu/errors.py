@@ -1,0 +1,59 @@
+"""Typed error hierarchy (reference: ``moose/src/error.rs:7-59``).
+
+The reference carries a closed ``Error`` enum through every kernel and
+session; here the same taxonomy is an exception hierarchy so protocol
+invariants survive ``python -O`` (a bare ``assert`` would not) and callers
+can catch by failure class.
+"""
+
+from __future__ import annotations
+
+
+class MooseError(Exception):
+    """Base class for all moose_tpu errors (reference Error, error.rs:7)."""
+
+
+class KernelError(MooseError):
+    """A kernel was invoked with operands violating its contract
+    (reference Error::KernelError)."""
+
+
+class TypeMismatchError(MooseError, TypeError):
+    """Unexpected value/dtype/ring width at a kernel or dispatch boundary
+    (reference Error::TypeMismatch)."""
+
+
+class CompilationError(MooseError):
+    """A compiler pass failed (reference Error::Compilation)."""
+
+
+class MalformedComputationError(CompilationError):
+    """The computation graph violates well-formedness (reference
+    Error::MalformedComputation / MalformedEnvironment)."""
+
+
+class MissingArgumentError(MooseError, KeyError):
+    """An Input op had no bound argument at evaluation time."""
+
+
+class NetworkingError(MooseError):
+    """Transport-level send/receive failure (reference Error::Networking)."""
+
+
+class StorageError(MooseError, KeyError):
+    """Load/Save against a storage backend failed (reference
+    Error::Storage)."""
+
+
+class SessionAlreadyExistsError(MooseError):
+    """A session id was launched twice on one worker (reference
+    Error::SessionAlreadyExists, execution/asynchronous.rs:571-576)."""
+
+
+class UnimplementedError(MooseError, NotImplementedError):
+    """Operator/placement combination not supported (reference
+    Error::UnimplementedOperator)."""
+
+
+class ConfigurationError(MooseError, ValueError):
+    """Invalid runtime/session configuration."""

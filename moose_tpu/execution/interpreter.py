@@ -45,9 +45,12 @@ _BOUNDARY_KINDS = frozenset({"Input", "Load", "Save", "Output"})
 
 @dataclasses.dataclass
 class _Plan:
-    """Static execution plan for one (computation, binding) pair."""
+    """Static execution plan for one (computation, binding) pair.
 
-    comp: Computation
+    Deliberately does NOT hold the Computation: plans are cached in a
+    weak-keyed dict keyed by the computation, and a strong back-reference
+    from the value would keep every entry alive forever."""
+
     order: list[str]
     static_env: dict[str, Any]  # op name -> static value (strings, scalars)
     dynamic_names: list[str]  # Input/Load ops fed arrays at call time
@@ -93,7 +96,18 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
     ):
         use_jit = False
 
+    import weakref
+
+    # The closure must not keep the computation alive: the compiled plan is
+    # cached weak-keyed on the computation, so a strong capture here would
+    # make eviction impossible.  While any caller can invoke `core` it also
+    # holds the computation, so the deref below cannot fail in practice.
+    comp_ref = weakref.ref(comp)
+
     def core(master_key, dyn: dict):
+        comp = comp_ref()
+        if comp is None:  # pragma: no cover - defensive
+            raise RuntimeError("computation was garbage-collected")
         sess = EagerSession(master_key=master_key)
         logical.bind_placements(sess, comp)
         env: dict[str, Any] = {}
@@ -131,7 +145,7 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
             env[name] = logical.execute_op(sess, comp, op, args)
         return outputs, saves
 
-    return _Plan(comp, order, static_env, dynamic_names, use_jit, core)
+    return _Plan(order, static_env, dynamic_names, use_jit, core)
 
 
 def _lift_array(arr, op, plc_name: str):
@@ -160,10 +174,16 @@ def _lift_array(arr, op, plc_name: str):
 
 
 class Interpreter:
-    """Caches compiled plans per (computation, binding signature)."""
+    """Caches compiled plans per (computation, binding signature).
+
+    The outer cache is weak-keyed on the Computation object itself — an
+    ``id()`` key could be reused by a new computation after the old one is
+    garbage-collected and silently serve a stale plan."""
 
     def __init__(self):
-        self._cache: dict = {}
+        import weakref
+
+        self._cache = weakref.WeakKeyDictionary()
 
     def evaluate(
         self,
@@ -173,12 +193,15 @@ class Interpreter:
         use_jit: bool = True,
     ) -> dict:
         arguments = arguments or {}
-        cache_key = self._cache_key(comp, arguments, use_jit)
-        cached = self._cache.get(cache_key)
+        per_comp = self._cache.get(comp)
+        if per_comp is None:
+            per_comp = self._cache[comp] = {}
+        cache_key = self._cache_key(arguments, use_jit)
+        cached = per_comp.get(cache_key)
         if cached is None:
             plan = build_plan(comp, arguments, use_jit)
             fn = jax.jit(plan.core) if plan.use_jit else plan.core
-            self._cache[cache_key] = (plan, fn)
+            per_comp[cache_key] = (plan, fn)
         else:
             plan, fn = cached
 
@@ -216,8 +239,8 @@ class Interpreter:
             "(a string constant or string argument)"
         )
 
-    def _cache_key(self, comp, arguments, use_jit):
-        parts = [id(comp), use_jit]
+    def _cache_key(self, arguments, use_jit):
+        parts = [use_jit]
         for name, val in sorted(arguments.items()):
             if isinstance(val, (str, int, float)):
                 parts.append((name, val))

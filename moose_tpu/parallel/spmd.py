@@ -444,14 +444,27 @@ def fx_sigmoid_poly(sess, x: SpmdFixed) -> SpmdFixed:
 # ---------------------------------------------------------------------------
 
 
-def make_mesh(n_devices: Optional[int] = None):
-    """Mesh with axes (parties, data): parties=3 when the device count
-    allows, else 1 (parties then co-located and data-parallel only)."""
-    devices = jax.devices()[: n_devices or len(jax.devices())]
+def make_mesh(n_devices: Optional[int] = None, devices=None):
+    """Mesh with axes (parties, data).
+
+    Whenever >=3 devices are available the party axis is a genuine size-3
+    mesh axis (so share resharing lowers to collective-permute over ICI),
+    with ``data = n // 3`` and any remainder devices left unused — e.g. a
+    v5e-8 slice becomes a (3, 2) mesh over 6 of its 8 chips, which beats
+    co-locating all three parties on every chip (reference: 3 workers on
+    separate hosts, ``execution/asynchronous.rs:590-605``).  With fewer
+    than 3 devices the parties are co-located (parties=1) and remaining
+    devices shard the batch.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)[: n_devices or len(devices)]
     n = len(devices)
-    p = 3 if n % 3 == 0 else 1
-    d = n // p
-    arr = np.array(devices).reshape(p, d)
+    if n >= 3:
+        p, d = 3, n // 3
+    else:
+        p, d = 1, n
+    arr = np.array(devices[: p * d]).reshape(p, d)
     return jax.sharding.Mesh(arr, ("parties", "data"))
 
 

@@ -105,6 +105,11 @@ class GrpcMooseRuntime:
     GrpcMooseRuntime, execution/grpc.rs:11-146)."""
 
     def __init__(self, identities: Dict):
+        # Masks for genuinely-distributed parties must come from a real PRF
+        # (ADVICE r1: the rbg default is not cryptographic).
+        from .dialects.ring import require_strong_prf
+
+        require_strong_prf("GrpcMooseRuntime")
         self.identities = {
             (
                 role.name
@@ -113,7 +118,13 @@ class GrpcMooseRuntime:
             ): addr
             for role, addr in identities.items()
         }
-        from .distributed.client import GrpcClientRuntime
+        try:
+            from .distributed.client import GrpcClientRuntime
+        except ModuleNotFoundError as e:
+            raise NotImplementedError(
+                "the distributed gRPC runtime is not available in this "
+                "build; use LocalMooseRuntime for single-process execution"
+            ) from e
 
         self._client = GrpcClientRuntime(self.identities)
 

@@ -212,3 +212,80 @@ def test_jit_cache_reuse_fresh_randomness():
         )
         (got,) = outs.values()
         np.testing.assert_allclose(got, np.square(val), atol=1e-6)
+
+
+def test_ellipsis_slice_targets_trailing_axis():
+    """x[..., 0:1] must slice the LAST axis regardless of rank (a trace-time
+    rewrite of Ellipsis to one slice(None) would shift axes)."""
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            y = x[..., 0:1]
+        return y
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    x = np.arange(24, dtype=np.float64).reshape(2, 3, 4)
+    (val,) = runtime.evaluate_computation(comp, arguments={"x": x}).values()
+    np.testing.assert_allclose(val, x[..., 0:1])
+
+
+def test_shape_open_bounds_slicing():
+    """shape(x)[1:] with open bounds must work (reference base.py:170-187)."""
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            s = pm.shape(x)
+            tail = s[1:]
+            y = pm.ones(tail, dtype=pm.float64)
+        return y
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    x = np.zeros((2, 5))
+    (val,) = runtime.evaluate_computation(comp, arguments={"x": x}).values()
+    np.testing.assert_allclose(val, np.ones((5,)))
+
+
+def test_unsigned_neg_rejected():
+    from moose_tpu.edsl import tracer
+
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.uint64)):
+        with alice:
+            y = -x
+        return y
+
+    with pytest.raises(TypeError, match="unsigned"):
+        tracer.trace(comp)
+
+
+def test_plan_cache_evicts_on_gc():
+    """Interpreter plan cache must not keep dead computations alive."""
+    import gc
+    import weakref
+
+    from moose_tpu.edsl import tracer
+
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            y = x + x
+        return y
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    traced = tracer.trace(comp)
+    runtime.evaluate_computation(traced, arguments={"x": np.ones(3)})
+    interp = runtime._interpreter
+    assert len(interp._cache) == 1
+    ref = weakref.ref(traced)
+    del traced
+    gc.collect()
+    assert ref() is None, "plan cache kept the computation alive"
+    assert len(interp._cache) == 0

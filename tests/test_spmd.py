@@ -100,6 +100,30 @@ def test_logreg_step_unsharded_matches_numpy():
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
+def test_make_mesh_8_devices_keeps_party_axis():
+    """v5e-8-style device counts must still get a real parties=3 axis
+    (VERDICT r1 #2): 8 devices -> (3, 2) mesh over 6 of them."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = spmd.make_mesh(8)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "parties": 3,
+        "data": 2,
+    }
+    # and the stacked share sharding actually splits the party axis
+    sh = spmd.rep_sharding(mesh, batch_axis=0, ndim=2)
+    assert sh.spec[0] == "parties"
+
+
+@pytest.mark.parametrize("n,want", [(1, (1, 1)), (2, (1, 2)), (3, (3, 1)),
+                                    (4, (3, 1)), (6, (3, 2)), (7, (3, 2))])
+def test_make_mesh_shapes(n, want):
+    if len(jax.devices()) < n:
+        pytest.skip("not enough virtual devices")
+    mesh = spmd.make_mesh(n)
+    assert mesh.devices.shape == want
+
+
 def test_logreg_step_sharded_party_mesh():
     """Full train step jitted over a genuine (parties=3, data=2) mesh."""
     if len(jax.devices()) < 6:
