@@ -196,12 +196,13 @@ def add_public_float(sess, rep, x: RepFixedTensor, value: float) -> RepFixedTens
 
 
 def polynomial_eval(
-    sess, rep, coeffs: Sequence[float], x: RepFixedTensor
+    sess, rep, coeffs: Sequence[float], x: RepFixedTensor, min_coeff=None
 ) -> RepFixedTensor:
-    """Horner evaluation; coefficients below the representable precision are
-    dropped (as the reference does) to bound the degree."""
+    """Horner evaluation; coefficients below the representable precision
+    (or the caller's accuracy target ``min_coeff``) are dropped, as the
+    reference does, to bound the degree."""
     f = x.fractional_precision
-    eps = 2.0 ** -(f + 1)
+    eps = max(2.0 ** -(f + 1), min_coeff or 0.0)
     top = len(coeffs)
     while top > 1 and abs(coeffs[top - 1]) < eps:
         top -= 1
@@ -343,33 +344,40 @@ P_1045 = [math.log(2.0) ** i / math.factorial(i) for i in range(100)]
 
 def pow2_from_bits(sess, rep, bits: Sequence[RepTensor], width: int) -> RepTensor:
     """prod_i (b_i * 2^(2^i) + (1 - b_i)) (exp.rs:119-157); bits are
-    arithmetic ring shares of the integer exponent's bits."""
-    acc = None
+    arithmetic ring shares of the integer exponent's bits.  The product is
+    reduced as a balanced tree (depth log2(n)) rather than a left fold —
+    same multiplication count, but short dependency chains schedule better
+    under XLA and cost fewer protocol rounds when parties are remote."""
+    sels = []
     for i, bit in enumerate(bits):
         pos = rep_ops.shl(sess, rep, bit, 1 << i)
         neg_b = public_sub_raw(sess, rep, 1, bit)
-        sel = rep_ops.add(sess, rep, pos, neg_b)
-        acc = sel if acc is None else rep_ops.mul(sess, rep, acc, sel)
-    return acc
+        sels.append(rep_ops.add(sess, rep, pos, neg_b))
+    while len(sels) > 1:
+        paired = []
+        for j in range(0, len(sels) - 1, 2):
+            paired.append(rep_ops.mul(sess, rep, sels[j], sels[j + 1]))
+        if len(sels) % 2:
+            paired.append(sels[-1])
+        sels = paired
+    return sels[0]
 
 
-def pow2(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
-    """2^x for secret fixed-point x (exp.rs:11-112)."""
-    i_p = x.integral_precision
-    f_p = x.fractional_precision
+def _pow2_positive(sess, rep, x_abs: RepTensor, i_p: int, f_p: int) -> RepTensor:
+    """2^x for a NON-NEGATIVE secret fixed-point value (raw ring shares at
+    scale f).  The sign/reciprocal handling of ``pow2`` is factored out so
+    callers that already know the sign (sigmoid) can skip the expensive
+    division branch entirely."""
     k = i_p + f_p
-    width = _width_of(x.tensor)
+    width = _width_of(x_abs)
 
-    bits = rep_ops.bit_decompose(sess, rep, x.tensor)
-    msb_bit = rep_ops.index_axis(sess, rep, bits, 0, width - 1)
-    m_ring = rep_ops.b2a(sess, rep, msb_bit, width)
-    abs_x = rep_ops.mux_ring(
-        sess, rep, m_ring, rep_ops.neg(sess, rep, x.tensor), x.tensor
-    )
-
-    abs_bits = rep_ops.bit_decompose(sess, rep, abs_x)
-    # integer-part bits (>= f), converted to arithmetic shares in one shot
-    n_int = min(i_p, width - f_p)
+    abs_bits = rep_ops.bit_decompose(sess, rep, x_abs)
+    # Integer-exponent bits: any exponent e >= width - f overflows the ring
+    # (2^e at scale f needs e + f < width), so bits above
+    # bit_length(width - f) select only overflowed values — skipping them
+    # changes nothing for in-range inputs and cuts the multiply chain from
+    # i_p (e.g. 24) to ~log2(width) (7) selects.
+    n_int = min(i_p, width - f_p, max(1, (width - f_p).bit_length()))
     int_bits = rep_ops.slice_axis0(sess, rep, abs_bits, f_p, f_p + n_int)
     int_ring = rep_ops.b2a_bits(sess, rep, int_bits, width)
     higher = [
@@ -379,18 +387,37 @@ def pow2(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
     composed = rep_ops.weighted_bit_sum(
         sess, rep, int_ring, [1 << (f_p + i) for i in range(n_int)], width
     )
-    frac = rep_ops.sub(sess, rep, abs_x, composed)
+    frac = rep_ops.sub(sess, rep, x_abs, composed)
 
     d = pow2_from_bits(sess, rep, higher, width)
 
     # exp_from_parts (exp.rs:177-215): evaluate 2^frac via the series at
-    # precision k-2, multiply by 2^int, truncate back to f.
+    # precision k-2, multiply by 2^int, truncate back to f.  The series
+    # only needs to resolve the OUTPUT precision f (plus slack), not the
+    # k-2 working precision, so the degree is capped accordingly.
     amount = k - 2 - f_p
     frac_up = rep_ops.shl(sess, rep, frac, amount)
     frac_fixed = RepFixedTensor(frac_up, 2, k - 2)
-    e_approx = polynomial_eval(sess, rep, P_1045, frac_fixed)
+    e_approx = polynomial_eval(
+        sess, rep, P_1045, frac_fixed, min_coeff=2.0 ** -(f_p + 4)
+    )
     e_prod = rep_ops.mul(sess, rep, d, e_approx.tensor)
-    g = rep_ops.trunc_pr(sess, rep, e_prod, amount)
+    return rep_ops.trunc_pr(sess, rep, e_prod, amount)
+
+
+def pow2(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
+    """2^x for secret fixed-point x (exp.rs:11-112)."""
+    i_p = x.integral_precision
+    f_p = x.fractional_precision
+    width = _width_of(x.tensor)
+
+    msb_bit = rep_ops.msb(sess, rep, x.tensor)
+    m_ring = rep_ops.b2a(sess, rep, msb_bit, width)
+    abs_x = rep_ops.mux_ring(
+        sess, rep, m_ring, rep_ops.neg(sess, rep, x.tensor), x.tensor
+    )
+
+    g = _pow2_positive(sess, rep, abs_x, i_p, f_p)
     g_fixed = RepFixedTensor(g, i_p, f_p)
 
     # negative exponent -> 1 / 2^|x|
@@ -488,17 +515,35 @@ def sqrt(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
 
 
 def sigmoid(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
-    """1 / (1 + e^-x)."""
-    e = exp(sess, rep, neg(sess, rep, x))
-    one_plus = add_public_float(sess, rep, e, 1.0)
-    one = RepFixedTensor(
-        fill_public(
-            sess, rep, x.tensor, 1 << x.fractional_precision
-        ),
-        x.integral_precision,
-        x.fractional_precision,
+    """1 / (1 + e^-x), via a single division.
+
+    With y = e^{|x|} (positive-branch pow2 only — no reciprocal needed):
+    x >= 0:  sigmoid = y / (1 + y)
+    x <  0:  sigmoid = (1/y) / (1 + 1/y) = 1 / (1 + y)
+    i.e. uniformly mux(x<0, 1, y) / (1 + y).  The naive composition
+    exp(-x) then 1/(1+e) runs the Goldschmidt machinery twice (once inside
+    pow2's negative branch, once for the outer division); this form runs
+    it once, which roughly halves sigmoid's protocol size."""
+    i_p, f_p = x.integral_precision, x.fractional_precision
+    width = _width_of(x.tensor)
+
+    z = mul_public_float(sess, rep, x, math.log2(math.e))  # e^x = 2^z
+    m = rep_ops.msb(sess, rep, z.tensor)
+    m_ring = rep_ops.b2a(sess, rep, m, width)
+    abs_z = rep_ops.mux_ring(
+        sess, rep, m_ring, rep_ops.neg(sess, rep, z.tensor), z.tensor
     )
-    return div(sess, rep, one, one_plus)
+    y = _pow2_positive(sess, rep, abs_z, i_p, f_p)
+
+    one_raw = fill_public(sess, rep, x.tensor, 1 << f_p)
+    num = rep_ops.mux_ring(sess, rep, m_ring, one_raw, y)
+    den = add_public_raw(sess, rep, y, 1 << f_p)
+    return div(
+        sess,
+        rep,
+        RepFixedTensor(num, i_p, f_p),
+        RepFixedTensor(den, i_p, f_p),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,44 @@
+"""Predictor zoo: encrypted inference over imported models (reference:
+``pymoose/pymoose/predictors/__init__.py``)."""
+
+from . import linear_predictor
+from . import multilayer_perceptron_predictor
+from . import neural_network_predictor
+from . import onnx_proto
+from . import predictor
+from . import predictor_utils
+from . import tree_ensemble
+from .linear_predictor import LinearClassifier, LinearRegressor
+from .multilayer_perceptron_predictor import MLPClassifier, MLPRegressor
+from .neural_network_predictor import NeuralNetwork
+from .onnx_convert import from_onnx
+from .predictor import AesWrapper, Predictor
+from .tree_ensemble import (
+    DecisionTreeRegressor,
+    TreeEnsembleClassifier,
+    TreeEnsembleRegressor,
+)
+
+__all__ = [
+    "AesWrapper",
+    "DecisionTreeRegressor",
+    "LinearClassifier",
+    "LinearRegressor",
+    "MLPClassifier",
+    "MLPRegressor",
+    "NeuralNetwork",
+    "Predictor",
+    "TreeEnsembleClassifier",
+    "TreeEnsembleRegressor",
+    "from_onnx",
+    "linear_predictor",
+    "multilayer_perceptron_predictor",
+    "neural_network_predictor",
+    "onnx_convert",
+    "onnx_proto",
+    "predictor",
+    "predictor_utils",
+    "tree_ensemble",
+]
+
+from . import onnx_convert  # noqa: E402  (module alias for __all__)

@@ -1,0 +1,285 @@
+"""Linear regression / classification predictors (reference:
+``pymoose/pymoose/predictors/linear_predictor.py``).
+
+Imports the ``ai.onnx.ml`` LinearRegressor / LinearClassifier operators and
+builds the encrypted inference graph: one replicated fixed-point ``dot``
+against mirrored weights (with the intercept folded in via the bias trick)
+followed by the model's post-transform (sigmoid / softmax / none).
+"""
+
+import abc
+from enum import Enum
+
+import numpy as np
+
+import moose_tpu as pm
+
+from . import predictor
+from . import predictor_utils
+
+
+class PostTransform(Enum):
+    """Variants of output processing for linear classification."""
+
+    NONE = 1
+    SIGMOID = 2
+    SOFTMAX = 3
+
+
+class LinearPredictor(predictor.Predictor, metaclass=abc.ABCMeta):
+    def __init__(self, coeffs, intercepts=None):
+        super().__init__()
+        self.coeffs, self.intercepts = _validate_model_args(coeffs, intercepts)
+
+    @classmethod
+    @abc.abstractmethod
+    def from_onnx(cls, model_proto):
+        pass
+
+    @abc.abstractmethod
+    def post_transform(self, y):
+        pass
+
+    @classmethod
+    def bias_trick(cls, x, plc, dtype):
+        """A column of ones broadcastable against ``x``, so the intercept
+        rides the same dot product as the coefficients."""
+        bias_shape = pm.shape(x, placement=plc)[0:1]
+        bias = pm.ones(bias_shape, dtype=pm.float64, placement=plc)
+        reshaped_bias = pm.expand_dims(bias, 1, placement=plc)
+        return pm.cast(reshaped_bias, dtype=dtype, placement=plc)
+
+    def predictor_fn(self, x, fixedpoint_dtype):
+        """The core linear map y = [1; x] @ [b; W]^T on shares."""
+        if self.intercepts is not None:
+            w = self.fixedpoint_constant(
+                np.concatenate([self.intercepts.T, self.coeffs], axis=1).T,
+                plc=self.mirrored,
+                dtype=fixedpoint_dtype,
+            )
+            bias = self.bias_trick(x, plc=self.bob, dtype=fixedpoint_dtype)
+            x = pm.concatenate([bias, x], axis=1)
+        else:
+            w = self.fixedpoint_constant(
+                self.coeffs.T, plc=self.mirrored, dtype=fixedpoint_dtype
+            )
+        return pm.dot(x, w)
+
+    def __call__(self, x, fixedpoint_dtype=predictor_utils.DEFAULT_FIXED_DTYPE):
+        y = self.predictor_fn(x, fixedpoint_dtype)
+        return self.post_transform(y)
+
+
+class LinearRegressor(LinearPredictor):
+    """Linear regression predictor.
+
+    Args:
+        coeffs: array-like (n_targets, n_features).
+        intercepts: optional array-like vector.
+    """
+
+    def post_transform(self, y):
+        return y
+
+    @classmethod
+    def from_onnx(cls, model_proto):
+        lr_node = predictor_utils.find_node_in_model_proto(
+            model_proto, "LinearRegressor", enforce=False
+        )
+        if lr_node is None:
+            raise ValueError(
+                "Incompatible ONNX graph provided: graph must contain a "
+                "LinearRegressor operator."
+            )
+
+        coeffs = _floats_attr(lr_node, "coefficients")
+        intercepts_attr = predictor_utils.find_attribute_in_node(
+            lr_node, "intercepts", enforce=False
+        )
+        intercepts = (
+            None
+            if intercepts_attr is None
+            else _check_floats(intercepts_attr, "LinearRegressor intercepts")
+        )
+
+        n_targets_attr = predictor_utils.find_attribute_in_node(
+            lr_node, "targets", enforce=False
+        )
+        if n_targets_attr is not None:
+            coeffs = coeffs.reshape(n_targets_attr.i, -1)
+
+        n_coeffs = coeffs.shape[-1]
+        _check_n_features(model_proto, n_coeffs)
+        return cls(coeffs=coeffs, intercepts=intercepts)
+
+
+class LinearClassifier(LinearPredictor):
+    """Linear classifier predictor.
+
+    Args:
+        coeffs: array-like (n_classes, n_features).
+        intercepts: optional array-like vector.
+        post_transform: PostTransform variant mapping raw scores to
+            probabilities.
+    """
+
+    def __init__(self, coeffs, intercepts=None, post_transform=None):
+        super().__init__(coeffs, intercepts)
+        n_classes = self.coeffs.shape[0]
+        if post_transform == PostTransform.NONE:
+            self._post_transform = lambda x: x
+        elif post_transform == PostTransform.SIGMOID and n_classes == 2:
+            self._post_transform = lambda x: pm.sigmoid(x)
+        elif post_transform == PostTransform.SIGMOID and n_classes > 2:
+            self._post_transform = lambda x: self._normalized_sigmoid(
+                x, axis=1
+            )
+        elif post_transform == PostTransform.SOFTMAX:
+            self._post_transform = lambda x: pm.softmax(
+                x, axis=1, upmost_index=n_classes
+            )
+        else:
+            raise ValueError(
+                "Could not infer post-transform in LinearClassifier"
+            )
+
+    @classmethod
+    def from_onnx(cls, model_proto):
+        lc_node = predictor_utils.find_node_in_model_proto(
+            model_proto, "LinearClassifier", enforce=False
+        )
+        if lc_node is None:
+            raise ValueError(
+                "Incompatible ONNX graph provided: graph must contain a "
+                "LinearClassifier operator."
+            )
+
+        coeffs = _floats_attr(lc_node, "coefficients")
+
+        classlabels = _classlabels(lc_node)
+        n_classes = len(classlabels)
+        coeffs = coeffs.reshape(n_classes, -1)
+        _check_n_features(model_proto, coeffs.shape[1])
+
+        intercepts_attr = predictor_utils.find_attribute_in_node(
+            lc_node, "intercepts", enforce=False
+        )
+        intercepts = (
+            None
+            if intercepts_attr is None
+            else _check_floats(
+                intercepts_attr, "LinearClassifier intercepts"
+            ).reshape(1, n_classes)
+        )
+
+        post_transform_attr = predictor_utils.find_attribute_in_node(
+            lc_node, "post_transform"
+        )
+        post_transform_str = bytes(post_transform_attr.s).decode()
+        try:
+            post_transform = {
+                "NONE": PostTransform.NONE,
+                "LOGISTIC": PostTransform.SIGMOID,
+                "SOFTMAX": PostTransform.SOFTMAX,
+            }[post_transform_str]
+        except KeyError:
+            raise RuntimeError(
+                f"{post_transform_str} post_transform is unsupported for "
+                "LinearClassifier."
+            )
+
+        return cls(
+            coeffs=coeffs,
+            intercepts=intercepts,
+            post_transform=post_transform,
+        )
+
+    def post_transform(self, y):
+        return self._post_transform(y)
+
+    def _normalized_sigmoid(self, x, axis):
+        """sklearn's OvR probability normalization: sigmoid then divide by
+        the row sum (instead of softmax)."""
+        y = pm.sigmoid(x)
+        y_sum = pm.expand_dims(pm.sum(y, axis), axis)
+        return pm.div(y, y_sum)
+
+
+def _floats_attr(node, name):
+    attr = predictor_utils.find_attribute_in_node(node, name)
+    return _check_floats(attr, f"{node.op_type} {name}")
+
+
+def _check_floats(attr, what):
+    if attr.type != 6:  # AttributeProto.FLOATS
+        raise ValueError(f"{what} must be of type FLOATS, found other.")
+    return np.asarray(list(attr.floats), dtype=np.float64)
+
+
+def _classlabels(node):
+    ints = predictor_utils.find_attribute_in_node(
+        node, "classlabels_ints", enforce=False
+    )
+    strings = predictor_utils.find_attribute_in_node(
+        node, "classlabels_strings", enforce=False
+    )
+    if ints is not None and len(ints.ints):
+        return list(ints.ints)
+    if strings is not None and len(strings.strings):
+        return list(strings.strings)
+    raise ValueError("LinearClassifier carries no class labels")
+
+
+def _check_n_features(model_proto, n_coeffs):
+    model_input = model_proto.graph.input[0]
+    input_shape = predictor_utils.find_input_shape(model_input)
+    if len(input_shape) != 2:
+        raise ValueError(
+            f"expected rank-2 model input, found rank {len(input_shape)}"
+        )
+    n_features = input_shape[1].dim_value
+    if n_features != n_coeffs:
+        raise ValueError(
+            f"In the ONNX file, the input shape has {n_features} "
+            f"features and there are {n_coeffs} coefficients. Validate "
+            "you set correctly the `initial_types` when converting "
+            "your model to ONNX."
+        )
+
+
+def _validate_model_args(coeffs, intercepts):
+    coeffs = _interpret_coeffs(coeffs)
+    intercepts = _interpret_intercepts(intercepts)
+    if intercepts is not None and coeffs.shape[0] != intercepts.shape[-1]:
+        raise ValueError(
+            "Shape mismatch between model coefficients and intercepts: "
+            f"Intercepts size of {coeffs.shape[0]} inferred from "
+            f"coefficients, found {intercepts.shape[-1]}."
+        )
+    return coeffs, intercepts
+
+
+def _interpret_coeffs(coeffs):
+    coeffs = np.asarray(coeffs, dtype=np.float64)
+    if coeffs.ndim == 1:
+        return np.expand_dims(coeffs, 0)
+    if coeffs.ndim == 2:
+        return coeffs
+    raise ValueError(
+        "Coeffs must be convertible to a rank-2 tensor, found shape of "
+        f"{coeffs.shape}."
+    )
+
+
+def _interpret_intercepts(intercepts):
+    if intercepts is None:
+        return None
+    intercepts = np.asarray(intercepts, dtype=np.float64)
+    if intercepts.ndim == 1:
+        return np.expand_dims(intercepts, 0)
+    if intercepts.ndim == 2 and intercepts.shape[0] == 1:
+        return intercepts
+    raise ValueError(
+        f"Intercept must be convertible to a vector, found shape of "
+        f"{intercepts.shape}."
+    )

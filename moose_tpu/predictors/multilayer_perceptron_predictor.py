@@ -1,0 +1,140 @@
+"""sklearn MLP predictors (reference:
+``pymoose/pymoose/predictors/multilayer_perceptron_predictor.py``).
+
+Imports skl2onnx-exported MLPRegressor/MLPClassifier graphs: stacked
+``coefficient``/``intercepts`` initializers with one hidden activation
+(sigmoid / relu / identity) shared across hidden layers.
+"""
+
+import abc
+from enum import Enum
+
+import numpy as np
+
+import moose_tpu as pm
+
+from . import onnx_proto
+from . import predictor
+from . import predictor_utils
+
+
+class Activation(Enum):
+    IDENTITY = 1
+    SIGMOID = 2
+    RELU = 3
+
+
+class MLPPredictor(predictor.Predictor, metaclass=abc.ABCMeta):
+    def __init__(self, weights, biases, activation):
+        super().__init__()
+        self.weights = weights
+        self.biases = biases
+        self.activation = activation
+
+    @classmethod
+    def from_onnx(cls, model_proto):
+        weights_data = predictor_utils.find_parameters_in_model_proto(
+            model_proto, ["coefficient"], enforce=False
+        )
+        biases_data = predictor_utils.find_parameters_in_model_proto(
+            model_proto, ["intercepts"], enforce=False
+        )
+        weights = [
+            onnx_proto.tensor_to_numpy(w).astype(np.float64)
+            for w in weights_data
+        ]
+        biases = [
+            onnx_proto.tensor_to_numpy(b).astype(np.float64).ravel()
+            for b in biases_data
+        ]
+
+        model_input = model_proto.graph.input[0]
+        input_shape = predictor_utils.find_input_shape(model_input)
+        if len(input_shape) != 2:
+            raise ValueError(
+                f"expected rank-2 model input, found rank {len(input_shape)}"
+            )
+        n_features = input_shape[1].dim_value
+        if n_features != weights[0].shape[0]:
+            raise ValueError(
+                f"In the ONNX file, the input shape has {n_features} "
+                "features and the shape of the weights for the first "
+                f"layer is: {weights[0].shape}. Validate you set "
+                "correctly the `initial_types` when converting "
+                "your model to ONNX."
+            )
+
+        activation_str = predictor_utils.find_activation_in_model_proto(
+            model_proto, "next_activations", enforce=False
+        )
+        if activation_str == "Sigmoid":
+            activation = Activation.SIGMOID
+        elif activation_str == "Relu":
+            activation = Activation.RELU
+        else:
+            activation = Activation.IDENTITY
+
+        return cls(weights, biases, activation)
+
+    @abc.abstractmethod
+    def post_transform(self, y, fixedpoint_dtype):
+        pass
+
+    def apply_layer(self, input, i, fixedpoint_dtype):
+        w = self.fixedpoint_constant(
+            self.weights[i], plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        b = self.fixedpoint_constant(
+            self.biases[i], plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        return pm.add(pm.dot(input, w), b)
+
+    def activation_fn(self, z, fixedpoint_dtype):
+        if self.activation == Activation.SIGMOID:
+            return pm.sigmoid(z)
+        if self.activation == Activation.RELU:
+            return pm.relu(z)
+        if self.activation == Activation.IDENTITY:
+            return z
+        raise ValueError("Invalid or unsupported activation function")
+
+    def neural_predictor_fn(self, x, fixedpoint_dtype):
+        num_hidden_layers = len(self.weights) - 1
+        for i in range(num_hidden_layers + 1):
+            x = self.apply_layer(x, i, fixedpoint_dtype)
+            if i < num_hidden_layers:
+                x = self.activation_fn(x, fixedpoint_dtype)
+        return x
+
+    def predictor_fn(self, x, fixedpoint_dtype):
+        return self.neural_predictor_fn(x, fixedpoint_dtype)
+
+    def __call__(
+        self, x, fixedpoint_dtype=predictor_utils.DEFAULT_FIXED_DTYPE
+    ):
+        y = self.neural_predictor_fn(x, fixedpoint_dtype)
+        return self.post_transform(y, fixedpoint_dtype)
+
+
+class MLPRegressor(MLPPredictor):
+    def post_transform(self, y, fixedpoint_dtype):
+        return y
+
+
+class MLPClassifier(MLPPredictor):
+    def post_transform(self, y, fixedpoint_dtype):
+        n_classes = np.shape(self.biases[-1])[0]
+        if n_classes == 1:
+            return self._sigmoid(y, fixedpoint_dtype)
+        if n_classes > 1:
+            return pm.softmax(y, axis=1, upmost_index=n_classes)
+        raise ValueError("Specify number of classes")
+
+    def _sigmoid(self, y, fixedpoint_dtype):
+        """Binary case: return both class probabilities."""
+        pos_prob = pm.sigmoid(y)
+        one = self.fixedpoint_constant(
+            1, plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        neg_prob = pm.sub(one, pos_prob)
+        return pm.concatenate([neg_prob, pos_prob], axis=1)

@@ -1,0 +1,133 @@
+"""Generic feed-forward NN predictor for pytorch / tf2onnx exports
+(reference: ``pymoose/pymoose/predictors/neural_network_predictor.py``).
+
+Walks the exported graph's Gemm/MatMul+Add structure, reads the
+weight/bias initializers, and rebuilds the network as replicated
+fixed-point layers with per-layer activations (sigmoid / relu / softmax /
+identity).
+"""
+
+from enum import Enum
+
+import numpy as np
+
+import moose_tpu as pm
+
+from . import onnx_proto
+from . import predictor
+from . import predictor_utils
+
+
+class Activation(Enum):
+    IDENTITY = 1
+    SIGMOID = 2
+    SOFTMAX = 3
+    RELU = 4
+
+
+class NeuralNetwork(predictor.Predictor):
+    def __init__(self, weights, biases, activations):
+        super().__init__()
+        self.weights = weights
+        self.biases = biases
+        self.activations = activations
+        self.n_classes = np.shape(biases[-1])[0]
+
+    def apply_layer(self, input, i, fixedpoint_dtype):
+        w = self.fixedpoint_constant(
+            self.weights[i], plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        b = self.fixedpoint_constant(
+            self.biases[i], plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        return pm.add(pm.dot(input, w), b)
+
+    def activation_fn(self, z, i):
+        activation = self.activations[i]
+        if activation == Activation.SIGMOID:
+            return pm.sigmoid(z)
+        if activation == Activation.RELU:
+            return pm.relu(z)
+        if activation == Activation.SOFTMAX:
+            return pm.softmax(z, axis=1, upmost_index=self.n_classes)
+        if activation == Activation.IDENTITY:
+            return z
+        raise ValueError("Invalid or unsupported activation function")
+
+    def predictor_fn(self, x, fixedpoint_dtype):
+        for i in range(len(self.weights)):
+            x = self.apply_layer(x, i, fixedpoint_dtype)
+            x = self.activation_fn(x, i)
+        return x
+
+    def __call__(
+        self, x, fixedpoint_dtype=predictor_utils.DEFAULT_FIXED_DTYPE
+    ):
+        return self.predictor_fn(x, fixedpoint_dtype)
+
+    @classmethod
+    def from_onnx(cls, model_proto):
+        operations = predictor_utils.find_op_types_in_model_proto(model_proto)
+        activations = []
+        for i, op in enumerate(operations):
+            if op == "Sigmoid":
+                activations.append(Activation.SIGMOID)
+            elif op == "Softmax":
+                activations.append(Activation.SOFTMAX)
+            elif op == "Relu":
+                activations.append(Activation.RELU)
+            # pytorch: two adjacent Gemms -> implicit identity between them
+            if i > 0 and op == "Gemm" and operations[i - 1] == "Gemm":
+                activations.append(Activation.IDENTITY)
+            # tf keras: MatMul+Add pairs back to back -> implicit identity
+            if (
+                i > 2
+                and op == "Add"
+                and operations[i - 1] == "MatMul"
+                and operations[i - 2] == "Add"
+                and operations[i - 3] == "MatMul"
+            ):
+                activations.append(Activation.IDENTITY)
+
+        # pytorch names: {layer}.weight / {layer}.bias;
+        # tf2onnx names contain MatMul / BiasAdd
+        weights_data = predictor_utils.find_parameters_in_model_proto(
+            model_proto, ["weight", "MatMul"], enforce=False
+        )
+        biases_data = predictor_utils.find_parameters_in_model_proto(
+            model_proto, ["bias", "BiasAdd"], enforce=False
+        )
+
+        # pytorch Gemm stores W as (out, in) and computes x @ W^T
+        weights = [
+            onnx_proto.tensor_to_numpy(w).astype(np.float64).T
+            for w in weights_data
+        ]
+        biases = [
+            onnx_proto.tensor_to_numpy(b).astype(np.float64).ravel()
+            for b in biases_data
+        ]
+
+        if "tf" in model_proto.producer_name:
+            # tf2onnx lists parameters from last layer to first, and its
+            # MatMul weights are already (in, out): undo the blanket .T
+            weights = [w.T for w in weights[::-1]]
+            biases = biases[::-1]
+
+        model_input = model_proto.graph.input[0]
+        input_shape = predictor_utils.find_input_shape(model_input)
+        if len(input_shape) != 2:
+            raise ValueError(
+                f"expected rank-2 model input, found rank {len(input_shape)}"
+            )
+        n_features = input_shape[1].dim_value
+        if n_features != weights[0].shape[0]:
+            raise ValueError(
+                f"In the ONNX file, the input shape has {n_features} "
+                "features and the shape of the weights for the first "
+                f"layer is: {weights[0].shape}. Validate you set "
+                "correctly the `initial_types` when converting "
+                "your model to ONNX."
+            )
+
+        return cls(weights, biases, activations)

@@ -1,0 +1,99 @@
+"""Model-type inference for ONNX imports (reference:
+``pymoose/pymoose/predictors/onnx_convert.py:8-92``).
+
+``from_onnx`` sniffs the graph (op types, parameter naming, producer) and
+dispatches to the matching predictor family's ``from_onnx``.
+"""
+
+from . import linear_predictor
+from . import multilayer_perceptron_predictor
+from . import neural_network_predictor
+from . import onnx_proto
+from . import predictor_utils
+from . import tree_ensemble
+
+_SUPPORTED_OP_TYPES = (
+    "LinearRegressor",
+    "LinearClassifier",
+    "TreeEnsembleRegressor",
+    "TreeEnsembleClassifier",
+)
+
+
+def from_onnx(model_proto):
+    """Infer and construct a predictor from an ONNX model.
+
+    Args:
+        model_proto: an ONNX ModelProto (real ``onnx`` package or the
+            bundled shim), serialized bytes, or a path to a ``.onnx`` file.
+
+    Returns:
+        A predictor matching the model family.
+
+    Raises:
+        ValueError: if the predictor type cannot be inferred or the graph
+            is malformed for the inferred type.
+        RuntimeError: for unsupported LinearClassifier post_transforms.
+    """
+    model_proto = onnx_proto.load_model(model_proto)
+
+    if model_proto.producer_name in ("pytorch", "tf2onnx"):
+        model_type = "NeuralNetwork"
+        classes = None
+    else:
+        recognized_ops = []
+        unrecognized_ops = []
+        for node in model_proto.graph.node:
+            if node.op_type in _SUPPORTED_OP_TYPES:
+                recognized_ops.append(node.op_type)
+            else:
+                unrecognized_ops.append(node.op_type)
+
+        n_coefficients = len(
+            predictor_utils.find_parameters_in_model_proto(
+                model_proto, "coefficient", enforce=False
+            )
+        )
+
+        if len(recognized_ops) == 1:
+            model_type = recognized_ops.pop()
+            classes = None
+        elif len(recognized_ops) > 1:
+            raise ValueError(
+                "Incompatible ONNX graph provided: graph must contain at "
+                "most one node of type LinearRegressor or LinearClassifier "
+                "or TreeEnsembleRegressor or TreeEnsembleClassifier, found "
+                f"{recognized_ops}"
+            )
+        elif n_coefficients > 1:
+            # sklearn MLPs have no marker node but carry stacked
+            # coefficient initializers; classifiers additionally ZipMap
+            model_type = "MLP"
+            classes = predictor_utils.find_node_in_model_proto(
+                model_proto, "ZipMap", enforce=False
+            )
+        else:
+            raise ValueError(
+                "Incompatible ONNX graph provided: graph must contain a "
+                "LinearRegressor or LinearClassifier or "
+                "TreeEnsembleRegressor or TreeEnsembleClassifier node, "
+                f"found: {unrecognized_ops}"
+            )
+
+    if model_type == "LinearRegressor":
+        return linear_predictor.LinearRegressor.from_onnx(model_proto)
+    if model_type == "LinearClassifier":
+        return linear_predictor.LinearClassifier.from_onnx(model_proto)
+    if model_type == "TreeEnsembleRegressor":
+        return tree_ensemble.TreeEnsembleRegressor.from_onnx(model_proto)
+    if model_type == "TreeEnsembleClassifier":
+        return tree_ensemble.TreeEnsembleClassifier.from_onnx(model_proto)
+    if model_type == "MLP" and classes is None:
+        return multilayer_perceptron_predictor.MLPRegressor.from_onnx(
+            model_proto
+        )
+    if model_type == "MLP":
+        return multilayer_perceptron_predictor.MLPClassifier.from_onnx(
+            model_proto
+        )
+    return neural_network_predictor.NeuralNetwork.from_onnx(model_proto)

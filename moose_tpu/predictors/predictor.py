@@ -1,0 +1,119 @@
+"""Predictor base class + AES input wrapper (reference:
+``pymoose/pymoose/predictors/predictor.py:6-85``).
+
+A predictor owns the standard alice/bob/carole host placements plus the
+replicated and mirrored placements, and exposes ``predictor_fn`` /
+``__call__`` that build eDSL graphs for encrypted inference under 3-party
+replicated secret sharing.
+"""
+
+import abc
+
+import moose_tpu as pm
+
+from . import predictor_utils as utils
+
+
+class Predictor(metaclass=abc.ABCMeta):
+    """Base class for the moose_tpu predictor interface."""
+
+    def __init__(self):
+        (
+            (self.alice, self.bob, self.carole),
+            self.mirrored,
+            self.replicated,
+        ) = self._standard_replicated_placements()
+
+    @classmethod
+    def fixedpoint_constant(cls, x, plc=None, dtype=utils.DEFAULT_FIXED_DTYPE):
+        """Embed a constant and cast it to the working fixed-point dtype."""
+        x = pm.constant(x, dtype=pm.float64, placement=plc)
+        return pm.cast(x, dtype=dtype, placement=plc)
+
+    @classmethod
+    def handle_output(
+        cls, prediction, prediction_handler, output_dtype=utils.DEFAULT_FLOAT_DTYPE
+    ):
+        """Pin a value to an output placement, casting to a plaintext dtype."""
+        with prediction_handler:
+            result = pm.cast(prediction, dtype=output_dtype)
+        return result
+
+    @property
+    def host_placements(self):
+        return self.alice, self.bob, self.carole
+
+    def predictor_factory(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
+        """Standard plaintext-input computation: alice supplies x, bob
+        receives the prediction; the model itself runs replicated."""
+
+        @pm.computation
+        def predictor(x: pm.Argument(self.alice, dtype=pm.float64)):
+            with self.alice:
+                x_fixed = pm.cast(x, dtype=fixedpoint_dtype)
+            with self.replicated:
+                y = self(x_fixed, fixedpoint_dtype)
+            return self.handle_output(y, prediction_handler=self.bob)
+
+        return predictor
+
+    def _standard_replicated_placements(self):
+        alice = pm.host_placement("alice")
+        bob = pm.host_placement("bob")
+        carole = pm.host_placement("carole")
+        replicated = pm.replicated_placement(
+            name="replicated", players=[alice, bob, carole]
+        )
+        mirrored = pm.mirrored_placement(
+            name="mirrored", players=[alice, bob, carole]
+        )
+        return (alice, bob, carole), mirrored, replicated
+
+
+def AesWrapper(inner_model_cls):
+    """Extend a predictor class with AES-encrypted input handling
+    (reference predictor.py:49-85): the client uploads an AES-CTR
+    ciphertext, the key is secret-shared on the replicated placement, and
+    decryption happens under MPC."""
+
+    class AesPredictor(inner_model_cls):
+        def __call__(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
+            return self.aes_predictor_factory(fixedpoint_dtype)
+
+        @classmethod
+        def handle_aes_input(cls, aes_key, aes_data, decryptor):
+            if not isinstance(aes_data.vtype, pm.AesTensorType):
+                raise TypeError(
+                    f"expected AesTensorType input, found {aes_data.vtype}"
+                )
+            if not aes_data.vtype.dtype.is_fixedpoint:
+                raise TypeError("AES tensor payload must be fixed-point")
+            if not isinstance(aes_key.vtype, pm.AesKeyType):
+                raise TypeError(
+                    f"expected AesKeyType input, found {aes_key.vtype}"
+                )
+            with decryptor:
+                return pm.decrypt(aes_key, aes_data)
+
+        def aes_predictor_factory(
+            self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE
+        ):
+            @pm.computation
+            def predictor(
+                aes_data: pm.Argument(
+                    self.alice,
+                    vtype=pm.AesTensorType(dtype=fixedpoint_dtype),
+                ),
+                aes_key: pm.Argument(self.replicated, vtype=pm.AesKeyType()),
+            ):
+                x = self.handle_aes_input(
+                    aes_key, aes_data, decryptor=self.replicated
+                )
+                with self.replicated:
+                    pred = self.predictor_fn(x, fixedpoint_dtype)
+                return self.handle_output(pred, prediction_handler=self.bob)
+
+            return predictor
+
+    AesPredictor.__name__ = f"Aes{inner_model_cls.__name__}"
+    return AesPredictor

@@ -1,0 +1,4 @@
+"""Test alias: the sklearn->ONNX exporter lives in the package proper."""
+
+from moose_tpu.predictors.sklearn_export import *  # noqa: F401,F403
+from moose_tpu.predictors.sklearn_export import FLOAT, op  # noqa: F401

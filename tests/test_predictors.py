@@ -1,0 +1,204 @@
+"""Predictor zoo acceptance tests (modeled on the reference's
+``pymoose/pymoose/predictors/*_test.py``): train sklearn models, export to
+ONNX via the in-repo encoder, import with ``from_onnx``, run encrypted
+inference under LocalMooseRuntime, and compare against sklearn outputs
+within fixed-point tolerance."""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu import predictors
+from moose_tpu.predictors import predictor_utils
+from moose_tpu.runtime import LocalMooseRuntime
+
+import onnx_fixtures as fx
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn import ensemble, linear_model, neural_network  # noqa: E402
+
+RNG = np.random.default_rng(1234)
+
+
+def _run_predictor(model, x, serialize_roundtrip=False):
+    if serialize_roundtrip:
+        model = predictors.from_onnx(model.encode())
+    else:
+        model = predictors.from_onnx(model)
+    comp = model.predictor_factory()
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    outs = runtime.evaluate_computation(
+        comp, arguments={"x": np.asarray(x, dtype=np.float64)}
+    )
+    (res,) = outs.values()
+    return model, np.asarray(res)
+
+
+def _regression_data(n=40, d=5, targets=1):
+    x = RNG.normal(size=(n, d))
+    w = RNG.normal(size=(d, targets))
+    y = x @ w + 0.1 * RNG.normal(size=(n, targets))
+    return x, y if targets > 1 else y.ravel()
+
+
+def _classification_data(n=60, d=4, classes=2):
+    x = RNG.normal(size=(n, d))
+    y = RNG.integers(0, classes, size=n)
+    # make classes linearly separable-ish so probabilities aren't degenerate
+    x += 0.8 * np.eye(d)[y % d]
+    return x, y
+
+
+def test_linear_regressor_matches_sklearn():
+    x, y = _regression_data()
+    sk = linear_model.LinearRegression().fit(x, y)
+    onnx_model = fx.linear_regressor_onnx(sk, x.shape[1])
+    model, got = _run_predictor(onnx_model, x[:8], serialize_roundtrip=True)
+    assert isinstance(model, predictors.LinearRegressor)
+    np.testing.assert_allclose(
+        got.ravel(), sk.predict(x[:8]).ravel(), atol=1e-4
+    )
+
+
+def test_linear_regressor_two_targets():
+    x, y = _regression_data(targets=2)
+    sk = linear_model.LinearRegression().fit(x, y)
+    onnx_model = fx.linear_regressor_onnx(sk, x.shape[1])
+    _, got = _run_predictor(onnx_model, x[:8])
+    np.testing.assert_allclose(got, sk.predict(x[:8]), atol=1e-4)
+
+
+def test_logistic_regression_binary_matches_sklearn():
+    x, y = _classification_data(classes=2)
+    sk = linear_model.LogisticRegression().fit(x, y)
+    onnx_model = fx.logistic_regression_onnx(sk, x.shape[1])
+    model, got = _run_predictor(onnx_model, x[:8], serialize_roundtrip=True)
+    assert isinstance(model, predictors.LinearClassifier)
+    np.testing.assert_allclose(got, sk.predict_proba(x[:8]), atol=5e-3)
+
+
+def test_logistic_regression_multiclass_softmax():
+    x, y = _classification_data(classes=3)
+    sk = linear_model.LogisticRegression().fit(x, y)
+    onnx_model = fx.logistic_regression_onnx(sk, x.shape[1])
+    _, got = _run_predictor(onnx_model, x[:8])
+    np.testing.assert_allclose(got, sk.predict_proba(x[:8]), atol=5e-3)
+
+
+def test_random_forest_regressor():
+    x, y = _regression_data(n=80)
+    sk = ensemble.RandomForestRegressor(
+        n_estimators=4, max_depth=3, random_state=0
+    ).fit(x, y)
+    onnx_model = fx.random_forest_regressor_onnx(sk, x.shape[1])
+    model, got = _run_predictor(onnx_model, x[:6], serialize_roundtrip=True)
+    assert isinstance(model, predictors.TreeEnsembleRegressor)
+    np.testing.assert_allclose(got.ravel(), sk.predict(x[:6]), atol=1e-3)
+
+
+def test_random_forest_classifier_binary():
+    x, y = _classification_data(n=80, classes=2)
+    sk = ensemble.RandomForestClassifier(
+        n_estimators=4, max_depth=3, random_state=0
+    ).fit(x, y)
+    onnx_model = fx.random_forest_classifier_onnx(sk, x.shape[1])
+    model, got = _run_predictor(onnx_model, x[:6])
+    assert isinstance(model, predictors.TreeEnsembleClassifier)
+    np.testing.assert_allclose(got, sk.predict_proba(x[:6]), atol=1e-3)
+
+
+def test_random_forest_classifier_multiclass():
+    x, y = _classification_data(n=90, classes=3)
+    sk = ensemble.RandomForestClassifier(
+        n_estimators=3, max_depth=2, random_state=0
+    ).fit(x, y)
+    onnx_model = fx.random_forest_classifier_onnx(sk, x.shape[1])
+    _, got = _run_predictor(onnx_model, x[:6])
+    np.testing.assert_allclose(got, sk.predict_proba(x[:6]), atol=1e-3)
+
+
+@pytest.mark.parametrize("activation", ["relu", "logistic"])
+def test_mlp_regressor(activation):
+    x, y = _regression_data(n=60)
+    sk = neural_network.MLPRegressor(
+        hidden_layer_sizes=(8,),
+        activation=activation,
+        max_iter=200,
+        random_state=0,
+    ).fit(x, y)
+    onnx_model = fx.mlp_onnx(sk, x.shape[1])
+    model, got = _run_predictor(onnx_model, x[:6], serialize_roundtrip=True)
+    assert isinstance(model, predictors.MLPRegressor)
+    np.testing.assert_allclose(got.ravel(), sk.predict(x[:6]), atol=5e-3)
+
+
+def test_mlp_classifier_binary():
+    x, y = _classification_data(n=70, classes=2)
+    sk = neural_network.MLPClassifier(
+        hidden_layer_sizes=(6,),
+        activation="relu",
+        max_iter=200,
+        random_state=0,
+    ).fit(x, y)
+    onnx_model = fx.mlp_onnx(sk, x.shape[1], classifier=True)
+    model, got = _run_predictor(onnx_model, x[:6])
+    assert isinstance(model, predictors.MLPClassifier)
+    np.testing.assert_allclose(got, sk.predict_proba(x[:6]), atol=1e-2)
+
+
+def test_mlp_classifier_multiclass():
+    x, y = _classification_data(n=90, classes=3)
+    sk = neural_network.MLPClassifier(
+        hidden_layer_sizes=(6,),
+        activation="logistic",
+        max_iter=200,
+        random_state=0,
+    ).fit(x, y)
+    onnx_model = fx.mlp_onnx(sk, x.shape[1], classifier=True)
+    _, got = _run_predictor(onnx_model, x[:6])
+    np.testing.assert_allclose(got, sk.predict_proba(x[:6]), atol=1e-2)
+
+
+def test_pytorch_neural_network():
+    d = 4
+    w0 = RNG.normal(size=(6, d)) * 0.5  # pytorch (out, in) layout
+    b0 = RNG.normal(size=(6,)) * 0.1
+    w1 = RNG.normal(size=(1, 6)) * 0.5
+    b1 = RNG.normal(size=(1,)) * 0.1
+    onnx_model = fx.pytorch_nn_onnx(
+        [w0, w1], [b0, b1], ["Relu", "Sigmoid"], d
+    )
+    x = RNG.normal(size=(5, d))
+    model, got = _run_predictor(onnx_model, x, serialize_roundtrip=True)
+    assert isinstance(model, predictors.NeuralNetwork)
+
+    h = np.maximum(x.astype(np.float32) @ w0.T.astype(np.float32) + b0, 0)
+    want = 1 / (1 + np.exp(-(h @ w1.T + b1)))
+    np.testing.assert_allclose(got, want, atol=1e-2)
+
+
+def test_onnx_roundtrip_preserves_structure():
+    x, y = _regression_data()
+    sk = linear_model.LinearRegression().fit(x, y)
+    model = fx.linear_regressor_onnx(sk, x.shape[1])
+    decoded = predictors.onnx_proto.ModelProto.decode(model.encode())
+    assert decoded.producer_name == "skl2onnx"
+    node = decoded.graph.node[0]
+    assert node.op_type == "LinearRegressor"
+    coeffs = predictor_utils.find_attribute_in_node(node, "coefficients")
+    np.testing.assert_allclose(
+        np.asarray(coeffs.floats, dtype=np.float64),
+        np.asarray(sk.coef_, dtype=np.float32).ravel(),
+        rtol=1e-6,
+    )
+
+
+def test_from_onnx_rejects_unknown_graph():
+    graph = fx.op.GraphProto(
+        name="g",
+        node=[fx.op.make_node("Unknown", ["x"], ["y"])],
+        input=[fx.op.make_tensor_value_info("x", fx.FLOAT, [None, 2])],
+        output=[fx.op.make_tensor_value_info("y", fx.FLOAT, [None, 1])],
+    )
+    with pytest.raises(ValueError, match="Incompatible ONNX graph"):
+        predictors.from_onnx(fx.op.make_model(graph))
