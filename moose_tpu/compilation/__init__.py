@@ -1,0 +1,63 @@
+"""Compiler: passes over the placement-IR (reference
+``moose/src/compilation/mod.rs:17-132``).
+
+Pass order mirrors the reference's DEFAULT_PASSES = [Typing, Lowering,
+Prune, Networking, Toposort]; the Lowering pass is *running the dialect
+kernels under a SymbolicSession* — the same kernels that execute eagerly —
+so protocols are written once and serve as both implementation and lowering
+rules.
+
+TPU-specific deviation (documented): lowering requires static shapes for
+every Input/Load (XLA's compilation model; SURVEY §7 hard part (e)).  Shapes
+are supplied as ``arg_specs`` — usually derived from the example arguments
+of the first evaluation — and are baked into the lowered graph as HostShape
+constants.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..computation import Computation
+from ..errors import CompilationError
+from .lowering import lower
+from .networking import networking_pass
+from .pruning import prune
+from .toposort import toposort_pass
+from .typing import typing_pass
+from .well_formed import well_formed_check
+
+DEFAULT_PASSES = ["typing", "lowering", "prune", "networking", "toposort"]
+
+
+def compile_computation(
+    comp: Computation,
+    passes: Optional[list] = None,
+    arg_specs: Optional[dict] = None,
+) -> Computation:
+    """Run compiler passes over ``comp`` and return the compiled graph
+    (reference compile(), compilation/mod.rs:120-132)."""
+    if passes is None:
+        passes = list(DEFAULT_PASSES)
+    for p in passes:
+        if p == "typing":
+            comp = typing_pass(comp)
+        elif p == "lowering":
+            comp = lower(comp, arg_specs)
+        elif p == "prune":
+            comp = prune(comp)
+        elif p == "networking":
+            comp = networking_pass(comp)
+        elif p == "toposort":
+            comp = toposort_pass(comp)
+        elif p == "wellformed":
+            well_formed_check(comp)
+        elif p == "dump":
+            from ..textual import to_textual
+
+            print(to_textual(comp))
+        elif callable(p):
+            comp = p(comp) or comp
+        else:
+            raise CompilationError(f"unknown compiler pass: {p!r}")
+    return comp

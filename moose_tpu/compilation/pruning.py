@@ -1,0 +1,35 @@
+"""Prune pass: drop operations not reachable (reverse) from the graph's
+roots (reference compilation/pruning.rs:6).
+
+Roots are Output and Save ops (reference prunes from outputs; Save is also a
+side effect we must keep), plus Send ops when the pass runs after
+networking — a Send's value is consumed on another host, not via a local
+dataflow edge.
+"""
+
+from __future__ import annotations
+
+from ..computation import Computation
+
+_ROOT_KINDS = ("Output", "Save", "Send")
+
+
+def prune(comp: Computation) -> Computation:
+    keep: set[str] = set()
+    stack = [
+        op.name for op in comp.operations.values() if op.kind in _ROOT_KINDS
+    ]
+    # Receive ops keep their rendezvous'd Send alive implicitly via the
+    # _ROOT_KINDS entry above; dataflow edges do the rest.
+    while stack:
+        name = stack.pop()
+        if name in keep:
+            continue
+        keep.add(name)
+        stack.extend(comp.operations[name].inputs)
+
+    out = comp.clone_empty()
+    for name, op in comp.operations.items():
+        if name in keep:
+            out.operations[name] = op
+    return out

@@ -1,0 +1,35 @@
+"""Well-formedness check (reference compilation/well_formed.rs:13)."""
+
+from __future__ import annotations
+
+from ..computation import Computation, OPERATOR_SET
+from ..errors import MalformedComputationError
+
+
+def well_formed_check(comp: Computation) -> Computation:
+    for name, op in comp.operations.items():
+        if op.name != name:
+            raise MalformedComputationError(
+                f"operation map key {name!r} != op.name {op.name!r}"
+            )
+        if op.kind not in OPERATOR_SET:
+            raise MalformedComputationError(
+                f"op {name}: unknown operator kind {op.kind!r}"
+            )
+        if op.placement_name not in comp.placements:
+            raise MalformedComputationError(
+                f"op {name}: unknown placement {op.placement_name!r}"
+            )
+        for inp in op.inputs:
+            if inp not in comp.operations:
+                raise MalformedComputationError(
+                    f"op {name}: unknown input {inp!r}"
+                )
+        if op.signature.arity != len(op.inputs):
+            raise MalformedComputationError(
+                f"op {name}: signature arity {op.signature.arity} != "
+                f"{len(op.inputs)} inputs"
+            )
+    # cycle check
+    comp.toposort_names()
+    return comp

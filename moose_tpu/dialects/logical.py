@@ -162,23 +162,23 @@ def _host_fixed_binop(sess, plc, x: HostFixedTensor, y: HostFixedTensor, op):
     i = max(x.integral_precision, y.integral_precision)
     a, b = x.tensor, y.tensor
     if op == "Add":
-        z = host.ring_add(a, b, plc)
+        z = sess.add(plc, a, b)
     elif op == "Sub":
-        z = host.ring_sub(a, b, plc)
+        z = sess.sub(plc, a, b)
     elif op == "Mul":
-        z = host.ring_shr_arith(host.ring_mul(a, b, plc), f, plc)
+        z = sess.shr_arith(plc, sess.mul(plc, a, b), f)
     elif op == "Dot":
-        z = host.ring_shr_arith(host.ring_dot(a, b, plc), f, plc)
+        z = sess.shr_arith(plc, sess.dot(plc, a, b), f)
     else:
         raise ValueError(op)
     return HostFixedTensor(z, i, f)
 
 
 def _host_fixed_via_float(sess, plc, op_fn, x: HostFixedTensor):
-    v = host.fixedpoint_decode(x, plc)
+    v = sess.fixedpoint_decode(plc, x)
     out = op_fn(v)
-    return host.fixedpoint_encode(
-        out, x.integral_precision, x.fractional_precision, x.tensor.width, plc
+    return sess.fixedpoint_encode(
+        plc, out, x.integral_precision, x.fractional_precision, x.tensor.width
     )
 
 
@@ -231,14 +231,16 @@ _REP_STRUCTURAL = {
     "IndexAxis": rep_ops.index_axis,
 }
 
+# kind -> session method name (dispatched per-session so symbolic lowering
+# records these as graph nodes)
 _HOST_MATH = {
-    "Exp": host.exp,
-    "Log": host.log,
-    "Log2": host.log2,
-    "Sqrt": host.sqrt,
-    "Sigmoid": host.sigmoid,
-    "Relu": host.relu,
-    "Abs": host.abs_,
+    "Exp": "exp",
+    "Log": "log",
+    "Log2": "log2",
+    "Sqrt": "sqrt",
+    "Sigmoid": "sigmoid",
+    "Relu": "relu",
+    "Abs": "abs",
 }
 
 _REP_MATH = {
@@ -298,12 +300,12 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         if isinstance(x, HostFixedTensor) or isinstance(y, HostFixedTensor):
             if kind == "Div":
                 # plaintext fixed division via float (documented deviation)
-                xv = host.fixedpoint_decode(x, h)
-                yv = host.fixedpoint_decode(y, h)
+                xv = sess.fixedpoint_decode(h, x)
+                yv = sess.fixedpoint_decode(h, y)
                 out = sess.div(h, xv, yv)
-                return host.fixedpoint_encode(
-                    out, x.integral_precision, x.fractional_precision,
-                    x.tensor.width, h,
+                return sess.fixedpoint_encode(
+                    h, out, x.integral_precision, x.fractional_precision,
+                    x.tensor.width,
                 )
             return _host_fixed_binop(sess, h, x, y, kind)
         fn = {
@@ -327,7 +329,7 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         x = to_host(sess, h, args[0])
         if isinstance(x, HostFixedTensor):
             return HostFixedTensor(
-                host.ring_neg(x.tensor, h),
+                sess.neg(h, x.tensor),
                 x.integral_precision,
                 x.fractional_precision,
             )
@@ -337,9 +339,9 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         x = to_host(sess, h, args[0])
         y = to_host(sess, h, args[1])
         if isinstance(x, HostFixedTensor):
-            x = host.fixedpoint_decode(x, h)
+            x = sess.fixedpoint_decode(h, x)
         if isinstance(y, HostFixedTensor):
-            y = host.fixedpoint_decode(y, h)
+            y = sess.fixedpoint_decode(h, y)
         fn = {"Less": sess.less, "Greater": sess.greater,
               "Equal": sess.equal}[kind]
         return fn(h, x, y)
@@ -379,15 +381,15 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         if isinstance(x, HostFixedTensor):
             if kind == "Sum":
                 return HostFixedTensor(
-                    host.ring_sum(x.tensor, axis, h),
+                    sess.sum(h, x.tensor, axis),
                     x.integral_precision,
                     x.fractional_precision,
                 )
-            scaled = host.ring_fixedpoint_mean(
-                x.tensor, axis, x.fractional_precision, h
+            scaled = sess.ring_fixedpoint_mean(
+                h, x.tensor, axis, x.fractional_precision
             )
             return HostFixedTensor(
-                host.ring_shr_arith(scaled, x.fractional_precision, h),
+                sess.shr_arith(h, scaled, x.fractional_precision),
                 x.integral_precision,
                 x.fractional_precision,
             )
@@ -396,27 +398,26 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
 
     if kind in _HOST_MATH:
         x = to_host(sess, h, args[0])
+        method = getattr(sess, _HOST_MATH[kind])
         if isinstance(x, HostFixedTensor):
-            return _host_fixed_via_float(
-                sess, h, lambda v: _HOST_MATH[kind](v, h), x
-            )
-        return _HOST_MATH[kind](x, h)
+            return _host_fixed_via_float(sess, h, lambda v: method(h, v), x)
+        return method(h, x)
 
     if kind == "Softmax":
         x = to_host(sess, h, args[0])
         axis = op.attributes["axis"]
         if isinstance(x, HostFixedTensor):
             return _host_fixed_via_float(
-                sess, h, lambda v: host.softmax(v, axis, h), x
+                sess, h, lambda v: sess.softmax(h, v, axis), x
             )
-        return host.softmax(x, axis, h)
+        return sess.softmax(h, x, axis)
 
     if kind == "Argmax":
         x = to_host(sess, h, args[0])
         axis = op.attributes["axis"]
         if isinstance(x, HostFixedTensor):
-            x = host.fixedpoint_decode(x, h)
-        return host.argmax(x, axis, h)
+            x = sess.fixedpoint_decode(h, x)
+        return sess.argmax(h, x, axis)
 
     if kind == "Maximum":
         vals = [to_host(sess, h, a) for a in args]
@@ -424,8 +425,8 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
             f = vals[0].fractional_precision
             i = vals[0].integral_precision
             w = vals[0].tensor.width
-            floats = [host.fixedpoint_decode(v, h) for v in vals]
-            return host.fixedpoint_encode(host.maximum(floats, h), i, f, w, h)
+            floats = [sess.fixedpoint_decode(h, v) for v in vals]
+            return sess.fixedpoint_encode(h, sess.maximum(h, floats), i, f, w)
         return sess.maximum(h, vals)
 
     if kind == "Concat":
@@ -450,7 +451,7 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         x = to_host(sess, h, args[0])
         index = to_host(sess, h, args[1])
         axis = op.attributes["axis"]
-        return host.select(x, axis, index, h)
+        return sess.select(h, x, axis, index)
 
     if kind == "Decrypt":
         from . import aes
@@ -469,17 +470,17 @@ def _constant_on_host(sess, h, op):
         return HostShape(tuple(int(d) for d in np.asarray(value)), h)
     dtype = ret.dtype
     if dtype is not None and dtype.is_fixedpoint:
-        t = host.constant(np.asarray(value, dtype=np.float64), h, dt.float64)
-        return host.fixedpoint_encode(
+        t = sess.constant(h, np.asarray(value, dtype=np.float64), dt.float64)
+        return sess.fixedpoint_encode(
+            h,
             t,
             dtype.integral_precision,
             dtype.fractional_precision,
             _width_of_dtype(dtype),
-            h,
         )
     if isinstance(value, (int, float)):
         return value  # static scalar (IntType/FloatType)
-    return host.constant(np.asarray(value), h, dtype)
+    return sess.constant(h, np.asarray(value), dtype)
 
 
 def _cast_on_host(sess, h, v, target: dt.DType):
@@ -490,29 +491,29 @@ def _cast_on_host(sess, h, v, target: dt.DType):
             df = target.fractional_precision - v.fractional_precision
             t = v.tensor
             if df > 0:
-                t = host.ring_shl(t, df, h)
+                t = sess.shl(h, t, df)
             elif df < 0:
-                t = host.ring_shr_arith(t, -df, h)
+                t = sess.shr_arith(h, t, -df)
             return HostFixedTensor(
                 t,
                 target.integral_precision,
                 target.fractional_precision,
             )
         assert isinstance(v, HostTensor)
-        return host.fixedpoint_encode(
+        return sess.fixedpoint_encode(
+            h,
             v,
             target.integral_precision,
             target.fractional_precision,
             _width_of_dtype(target),
-            h,
         )
     if isinstance(v, HostFixedTensor):
-        return host.fixedpoint_decode(v, h, target)
+        return sess.fixedpoint_decode(h, v, target)
     if isinstance(v, HostRingTensor):
         # e.g. revealed argmax indices
-        t = HostTensor(v.lo, h, dt.uint64)
-        return host.cast(t, target, h)
-    return host.cast(v, target, h)
+        t = sess.lift_ring_lo(h, v, dt.uint64)
+        return sess.cast(h, t, target)
+    return sess.cast(h, v, target)
 
 
 def _host_structural(sess, comp, op, h, args):
@@ -612,6 +613,9 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
             attributes=op.attributes,
         )
         h = _constant_on_host(sess, rep.owners[0], host_op)
+        if isinstance(h, (HostShape, HostString)):
+            # public metadata (shapes, storage keys) is never shared
+            return h
         return to_rep(sess, rep, h)
 
     if kind in ("Add", "Sub", "Mul", "Dot", "Div"):
@@ -823,12 +827,12 @@ def _execute_mir(sess, comp, op, plc: Mirrored3Placement, args):
             width = _width_of_dtype(ret_dtype)
             vals = []
             for owner in mir.owners:
-                t = host.constant(
-                    np.asarray(value, dtype=np.float64), owner, dt.float64
+                t = sess.constant(
+                    owner, np.asarray(value, dtype=np.float64), dt.float64
                 )
                 vals.append(
-                    host.ring_fixedpoint_encode(
-                        t, ret_dtype.fractional_precision, width, owner
+                    sess.ring_fixedpoint_encode(
+                        owner, t, ret_dtype.fractional_precision, width
                     )
                 )
             return Mir3FixedTensor(
@@ -837,7 +841,7 @@ def _execute_mir(sess, comp, op, plc: Mirrored3Placement, args):
                 ret_dtype.fractional_precision,
             )
         vals = tuple(
-            host.constant(np.asarray(value), owner, ret_dtype)
+            sess.constant(owner, np.asarray(value), ret_dtype)
             for owner in mir.owners
         )
         return Mir3Tensor(vals, mir.name)
@@ -848,8 +852,8 @@ def _execute_mir(sess, comp, op, plc: Mirrored3Placement, args):
         if isinstance(v, Mir3Tensor) and ret_dtype.is_fixedpoint:
             width = _width_of_dtype(ret_dtype)
             vals = tuple(
-                host.ring_fixedpoint_encode(
-                    t, ret_dtype.fractional_precision, width, t.plc
+                sess.ring_fixedpoint_encode(
+                    t.plc, t, ret_dtype.fractional_precision, width
                 )
                 for t in v.values
             )
@@ -860,8 +864,8 @@ def _execute_mir(sess, comp, op, plc: Mirrored3Placement, args):
             )
         if isinstance(v, Mir3FixedTensor) and not ret_dtype.is_fixedpoint:
             vals = tuple(
-                host.ring_fixedpoint_decode(
-                    t, v.fractional_precision, t.plc, ret_dtype
+                sess.ring_fixedpoint_decode(
+                    t.plc, t, v.fractional_precision, ret_dtype
                 )
                 for t in v.tensor.values
             )

@@ -123,7 +123,15 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
                 continue
             if op.kind in ("Input", "Load"):
                 arr = dyn[name]
-                env[name] = _lift_array(arr, op, plc.name)
+                ret_name = op.signature.return_type.name
+                if ret_name in (
+                    "AesTensor", "AesKey", "HostAesKey", "ReplicatedAesKey"
+                ):
+                    from ..dialects import aes
+
+                    env[name] = aes.lift_input(sess, comp, op, arr, plc.name)
+                else:
+                    env[name] = _lift_array(arr, op, plc.name)
                 continue
             if op.kind == "Save":
                 key = env[op.inputs[0]]
@@ -227,7 +235,8 @@ class Interpreter:
         for (plc_name, key), value in saves.items():
             storage.setdefault(plc_name, {})[key] = _to_user_value(value)
         return {
-            name: _to_user_value(value) for name, value in outputs.items()
+            name: _to_user_value(outputs[name])
+            for name in ordered_output_names(outputs)
         }
 
     def _resolve_load_key(self, plan, comp, op, arguments) -> str:
@@ -240,14 +249,21 @@ class Interpreter:
         )
 
     def _cache_key(self, arguments, use_jit):
-        parts = [use_jit]
-        for name, val in sorted(arguments.items()):
-            if isinstance(val, (str, int, float)):
-                parts.append((name, val))
-            else:
-                arr = np.asarray(val)
-                parts.append((name, arr.shape, str(arr.dtype)))
-        return tuple(parts)
+        return binding_cache_key(arguments, use_jit)
+
+
+def binding_cache_key(arguments, use_jit):
+    """Plan-cache key of one argument binding: shapes/dtypes for arrays,
+    values for static scalars/strings (shared by the logical and physical
+    interpreters)."""
+    parts = [use_jit]
+    for name, val in sorted(arguments.items()):
+        if isinstance(val, (str, int, float)):
+            parts.append((name, val))
+        else:
+            arr = np.asarray(val)
+            parts.append((name, arr.shape, str(arr.dtype)))
+    return tuple(parts)
 
 
 def _to_user_value(value):
@@ -264,3 +280,16 @@ def _to_user_value(value):
             to_numpy(host_ops.fixedpoint_decode(value, value.plc))
         )
     return to_numpy(value)
+
+
+def ordered_output_names(outputs) -> list:
+    """Outputs in declaration order: the tracer names them output_{i}
+    (tracer.py); execution may reach them in any topological order."""
+
+    import re
+
+    def sort_key(name):
+        m = re.match(r"output_(\d+)$", name)
+        return (0, int(m.group(1))) if m else (1, name)
+
+    return sorted(outputs, key=sort_key)

@@ -207,6 +207,9 @@ class EagerSession:
     def shr(self, plc, x, amount: int):
         return host.ring_shr(x, amount, plc)
 
+    def shr_arith(self, plc, x, amount: int):
+        return host.ring_shr_arith(x, amount, plc)
+
     # -- bits --------------------------------------------------------------
 
     def xor(self, plc, x, y):
@@ -299,3 +302,26 @@ class EagerSession:
 
     def cast(self, plc, x, target: dt.DType):
         return host.cast(x, target, plc)
+
+    def select(self, plc, x, axis, index):
+        return host.select(x, axis, index, plc)
+
+    def lift_ring_lo(self, plc, x, dtype=dt.uint64):
+        """Reinterpret the low 64-bit limb of a ring tensor as a plaintext
+        integer tensor (used for small non-negative values, e.g. revealed
+        argmax indices)."""
+        return HostTensor(x.lo, plc, dtype)
+
+    # -- host fixed-point wrappers (compositions of the ring methods, kept
+    #    on the session so every dialect path is session-routed and thus
+    #    symbolically traceable) ------------------------------------------
+
+    def fixedpoint_encode(self, plc, x, integ: int, frac: int, width: int):
+        return HostFixedTensor(
+            self.ring_fixedpoint_encode(plc, x, frac, width), integ, frac
+        )
+
+    def fixedpoint_decode(self, plc, x, dtype=dt.float64):
+        return self.ring_fixedpoint_decode(
+            plc, x.tensor, x.fractional_precision, dtype
+        )

@@ -63,6 +63,13 @@ class LocalMooseRuntime:
         # AbstractComputation reuse the compiled XLA executable; weak-keyed
         # on the object itself (an id() key could be reused after GC)
         self._trace_cache = weakref.WeakKeyDictionary()
+        # (traced computation, passes, binding) -> lowered Computation;
+        # holds compiled graphs strongly so the physical interpreter's
+        # weak-keyed jit cache stays warm
+        self._compiled_cache = weakref.WeakKeyDictionary()
+        from .execution.physical import PhysicalInterpreter
+
+        self._physical = PhysicalInterpreter()
 
     def set_default(self):
         edsl_base.set_current_runtime(self)
@@ -80,6 +87,52 @@ class LocalMooseRuntime:
                 self._trace_cache[computation] = traced
             computation = traced
         computation, arguments = _lift_computation(computation, arguments)
+        if compiler_passes is not None:
+            # explicit pass pipeline: lower to the host-level graph and run
+            # it through the physical executor (the reference's LocalRuntime
+            # always compiles; our default instead jit-fuses the logical
+            # graph directly — same results, fewer layers).  Compiled
+            # graphs are cached per (computation, passes, binding) so
+            # repeated evaluations reuse the lowered graph and its XLA
+            # executable.
+            from .compilation import compile_computation
+            from .compilation.lowering import arg_specs_from_arguments
+            from .execution.interpreter import binding_cache_key
+
+            specs = arg_specs_from_arguments(
+                arguments, storage=self.storage, comp=computation
+            )
+            # callable passes have no stable identity (an id()-based key
+            # could be reused after GC) — run them uncached
+            cacheable = all(isinstance(p, str) for p in compiler_passes)
+            compiled = None
+            key = None
+            if cacheable:
+                per_comp = self._compiled_cache.get(computation)
+                if per_comp is None:
+                    per_comp = self._compiled_cache[computation] = {}
+                # the key includes the storage-derived Load specs: a
+                # storage write that changes a loaded value's shape must
+                # miss the cache
+                key = (
+                    tuple(compiler_passes),
+                    binding_cache_key(arguments, self.use_jit),
+                    tuple(sorted(
+                        (n, s) if isinstance(s, (str, int, float))
+                        else (n, tuple(s[0]), str(s[1]))
+                        for n, s in specs.items()
+                    )),
+                )
+                compiled = per_comp.get(key)
+            if compiled is None:
+                compiled = compile_computation(
+                    computation, passes=compiler_passes, arg_specs=specs
+                )
+                if cacheable:
+                    per_comp[key] = compiled
+            return self._physical.evaluate(
+                compiled, self.storage, arguments, use_jit=self.use_jit
+            )
         return self._interpreter.evaluate(
             computation, self.storage, arguments, use_jit=self.use_jit
         )

@@ -1,0 +1,252 @@
+"""Compiler tests: SymbolicSession lowering, passes, and lowered-graph
+execution equivalence with the eager interpreter.
+
+Mirrors the reference's compilation tests (pruning.rs:31-50, networking.rs
+tests) plus end-to-end "lowered == eager" checks — the property that makes
+the session duality trustworthy.
+"""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.compilation import compile_computation, DEFAULT_PASSES
+from moose_tpu.compilation.lowering import arg_specs_from_arguments, lower
+from moose_tpu.compilation.networking import networking_pass
+from moose_tpu.compilation.pruning import prune
+from moose_tpu.computation import (
+    Computation,
+    HostPlacement,
+    Operation,
+    Signature,
+    Ty,
+    HostFloat64TensorTy,
+)
+from moose_tpu.edsl import tracer
+from moose_tpu.execution.physical import execute_physical
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def _build_manual_graph():
+    """x -> y = x+x -> output, plus a dangling op to prune."""
+    comp = Computation()
+    comp.add_placement(HostPlacement("alice"))
+    comp.add_placement(HostPlacement("bob"))
+    sig0 = Signature((), HostFloat64TensorTy)
+    comp.add_operation(Operation("x", "Input", [], "alice", sig0))
+    comp.add_operation(Operation(
+        "y", "Add", ["x", "x"], "alice",
+        Signature((HostFloat64TensorTy,) * 2, HostFloat64TensorTy)))
+    comp.add_operation(Operation(
+        "dangling", "Add", ["x", "x"], "alice",
+        Signature((HostFloat64TensorTy,) * 2, HostFloat64TensorTy)))
+    comp.add_operation(Operation(
+        "out", "Output", ["y"], "bob",
+        Signature((HostFloat64TensorTy,), HostFloat64TensorTy)))
+    return comp
+
+
+def test_prune_drops_unreachable():
+    comp = _build_manual_graph()
+    pruned = prune(comp)
+    assert "dangling" not in pruned.operations
+    assert set(pruned.operations) == {"x", "y", "out"}
+
+
+def test_networking_inserts_send_receive_pair():
+    comp = prune(_build_manual_graph())
+    netted = networking_pass(comp)
+    kinds = [op.kind for op in netted.operations.values()]
+    assert kinds.count("Send") == 1
+    assert kinds.count("Receive") == 1
+    send = next(o for o in netted.operations.values() if o.kind == "Send")
+    recv = next(o for o in netted.operations.values() if o.kind == "Receive")
+    assert send.placement_name == "alice"
+    assert recv.placement_name == "bob"
+    assert (
+        send.attributes["rendezvous_key"] == recv.attributes["rendezvous_key"]
+    )
+    assert send.attributes["receiver"] == "bob"
+    assert recv.attributes["sender"] == "alice"
+    out = netted.operations["out"]
+    assert out.inputs == [recv.name]
+    # the stitched graph still toposorts (Send precedes Receive)
+    order = netted.toposort_names()
+    assert order.index(send.name) < order.index(recv.name)
+
+
+def test_networking_dedupes_per_destination():
+    comp = Computation()
+    comp.add_placement(HostPlacement("alice"))
+    comp.add_placement(HostPlacement("bob"))
+    sig0 = Signature((), HostFloat64TensorTy)
+    comp.add_operation(Operation("x", "Input", [], "alice", sig0))
+    two = Signature((HostFloat64TensorTy,) * 2, HostFloat64TensorTy)
+    comp.add_operation(Operation("a", "Add", ["x", "x"], "bob", two))
+    comp.add_operation(Operation("b", "Mul", ["x", "x"], "bob", two))
+    comp.add_operation(Operation(
+        "out", "Output", ["a"], "bob",
+        Signature((HostFloat64TensorTy,), HostFloat64TensorTy)))
+    comp.add_operation(Operation(
+        "out2", "Output", ["b"], "bob",
+        Signature((HostFloat64TensorTy,), HostFloat64TensorTy)))
+    netted = networking_pass(comp)
+    kinds = [op.kind for op in netted.operations.values()]
+    # x is consumed twice on bob but crosses the wire once
+    assert kinds.count("Send") == 1
+    assert kinds.count("Receive") == 1
+
+
+def _eval_both_ways(comp_fn, arguments, storage=None):
+    """Evaluate via the eager interpreter and via
+    lower->prune->networking->toposort->physical; return both results."""
+    runtime = LocalMooseRuntime(
+        ["alice", "bob", "carole"],
+        storage_mapping=storage or {},
+    )
+    eager = runtime.evaluate_computation(comp_fn, arguments=arguments)
+
+    traced = tracer.trace(comp_fn)
+    specs = arg_specs_from_arguments(
+        arguments, storage=runtime.storage, comp=traced
+    )
+    compiled = compile_computation(
+        traced, passes=DEFAULT_PASSES + ["wellformed"], arg_specs=specs
+    )
+    # the lowered graph is host-only
+    for op in compiled.operations.values():
+        plc = compiled.placements[op.placement_name]
+        assert plc.kind == "Host", f"{op.name} on {plc.kind}"
+    storage2 = {k: dict(v) for k, v in (storage or {}).items()}
+    physical = execute_physical(compiled, storage2, arguments, use_jit=True)
+    return eager, physical, compiled
+
+
+def test_lowered_host_math_matches_eager():
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            y = pm.exp(x) + pm.constant(
+                np.array([1.0, 1.0, 1.0]), dtype=pm.float64
+            )
+        return y
+
+    x = np.array([0.0, 1.0, 2.0])
+    eager, physical, _ = _eval_both_ways(comp, {"x": x})
+    (e,) = eager.values()
+    (p,) = physical.values()
+    np.testing.assert_allclose(p, e, rtol=1e-12)
+
+
+def test_lowered_replicated_dot_matches_eager():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 5))
+    w = rng.normal(size=(5, 2))
+    eager, physical, compiled = _eval_both_ways(comp, {"x": x, "w": w})
+    (e,) = eager.values()
+    (p,) = physical.values()
+    np.testing.assert_allclose(p, x @ w, atol=1e-5)
+    np.testing.assert_allclose(e, x @ w, atol=1e-5)
+    # the secret-shared protocol really was expanded: sampling + send/recv
+    kinds = {op.kind for op in compiled.operations.values()}
+    assert "SampleSeeded" in kinds
+    assert "Send" in kinds and "Receive" in kinds
+
+
+def test_lowered_replicated_sigmoid_matches_eager():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.sigmoid(xf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    x = np.linspace(-3, 3, 12).reshape(3, 4)
+    eager, physical, _ = _eval_both_ways(comp, {"x": x})
+    (e,) = eager.values()
+    (p,) = physical.values()
+    np.testing.assert_allclose(p, 1 / (1 + np.exp(-x)), atol=5e-3)
+    np.testing.assert_allclose(p, e, atol=5e-3)
+
+
+def test_lowered_save_load_roundtrip():
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(key: pm.Argument(placement=alice, vtype=pm.StringType())):
+        with alice:
+            x = pm.load(key, dtype=pm.float64)
+            y = x * x
+            res = pm.save("squared", y)
+        return res
+
+    storage = {"alice": {"data": np.array([2.0, 3.0])}}
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"],
+                                storage_mapping=storage)
+    runtime.evaluate_computation(
+        comp, arguments={"key": "data"},
+        compiler_passes=DEFAULT_PASSES,
+    )
+    np.testing.assert_allclose(
+        runtime.read_value_from_storage("alice", "squared"), [4.0, 9.0]
+    )
+
+
+def test_runtime_compiler_passes_end_to_end():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        y: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(8, 27))
+        with bob:
+            yf = pm.cast(y, dtype=pm.fixed(8, 27))
+        with rep:
+            z = pm.mul(xf, yf)
+        with carole:
+            out = pm.cast(z, dtype=pm.float64)
+        return out
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    x = np.array([1.5, -2.0, 0.25])
+    y = np.array([4.0, 0.5, -8.0])
+    outs = runtime.evaluate_computation(
+        comp, arguments={"x": x, "y": y}, compiler_passes=DEFAULT_PASSES
+    )
+    (val,) = outs.values()
+    np.testing.assert_allclose(val, x * y, atol=1e-6)
