@@ -1,0 +1,621 @@
+"""AES-128 decryption on host bits and on replicated (secret-shared) bits.
+
+Re-design of the reference's encrypted dialect + Bristol-Fashion AES
+(``moose/src/encrypted/ops.rs:93-452``, ``moose/src/bristol_fashion/``).
+The reference streams 36k circuit gates one session call each; here AES is
+evaluated as a *bit-sliced, batched* circuit — the TPU-native shape:
+
+- the 16 state bytes are held as 8 bit-planes of shape ``(16,) + elem``
+  (plane j = bit j of every byte, MSB first), so every linear layer
+  (ShiftRows, MixColumns, squarings, the S-box affine) is a handful of
+  XORs/gathers over whole planes;
+- the S-box is computed algebraically: ``SBox(x) = A·x^254 ⊕ 0x63`` with
+  the inversion addition-chain ``x2=x^2, x3=x2·x, x12=x3^4, x15=x12·x3,
+  x240=x15^16, x252=x240·x12, x254=x252·x2`` — squarings are linear bit
+  matrices (derived numerically below), and each GF(2^8) multiplication is
+  ONE broadcasted AND of shape ``(8, 8, 16, ...)`` followed by XOR folds.
+  On the replicated placement that is a single communication round per
+  multiplication: 4 AND-rounds per S-box layer, ~80 for all of AES-128,
+  versus 6400 sequential ANDs for the gate-by-gate reference circuit.
+
+Bit conventions match the reference (bristol_fashion::byte_vec_to_bit_vec_be):
+arrays carry a leading bit axis, index ``8*b + j`` = bit j (MSB first) of
+byte b.  AES-GCM decryption of one 128-bit block: the keystream block is
+``AES(key, nonce ‖ counter=2)`` and plaintext = ciphertext ⊕ keystream
+(encrypted/ops.rs:395-452).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import dtypes as dt
+from ..errors import KernelError, TypeMismatchError
+from ..values import (
+    AesTensor,
+    HostAesKey,
+    HostBitTensor,
+    HostFixedTensor,
+    RepAesKey,
+    RepBitArray,
+    RepFixedTensor,
+    RepTensor,
+)
+from . import replicated as rep_ops
+
+# ---------------------------------------------------------------------------
+# Plaintext GF(2^8) / AES-128 reference (numpy ints) — used to derive the
+# linear bit-matrices of the circuit, for the host-side encryption helper,
+# and as the oracle in tests (validated against the FIPS-197 vector).
+# ---------------------------------------------------------------------------
+
+_POLY = 0x11B
+
+
+def gmul(a: int, b: int) -> int:
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= _POLY
+    return r
+
+
+def _gpow(a: int, e: int) -> int:
+    r = 1
+    while e:
+        if e & 1:
+            r = gmul(r, a)
+        a = gmul(a, a)
+        e >>= 1
+    return r
+
+
+def _affine(y: int) -> int:
+    # FIPS-197 affine map (LSB indexing): b_i = y_i ^ y_{i+4} ^ y_{i+5}
+    # ^ y_{i+6} ^ y_{i+7} ^ c_i with c = 0x63
+    out = 0
+    for i in range(8):
+        bit = 0
+        for k in (0, 4, 5, 6, 7):
+            bit ^= (y >> ((i + k) % 8)) & 1
+        bit ^= (0x63 >> i) & 1
+        out |= bit << i
+    return out
+
+
+SBOX = np.array(
+    [_affine(_gpow(x, 254)) if x else _affine(0) for x in range(256)],
+    dtype=np.uint8,
+)
+
+RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36]
+
+
+def aes128_encrypt_block_np(key: bytes, block: bytes) -> bytes:
+    """Plaintext AES-128 single-block encryption (oracle/helper)."""
+    assert len(key) == 16 and len(block) == 16
+
+    def sub_word(w):
+        return [int(SBOX[b]) for b in w]
+
+    # key schedule
+    words = [list(key[4 * i:4 * i + 4]) for i in range(4)]
+    for i in range(4, 44):
+        t = list(words[i - 1])
+        if i % 4 == 0:
+            t = sub_word(t[1:] + t[:1])
+            t[0] ^= RCON[i // 4 - 1]
+        words.append([a ^ b for a, b in zip(words[i - 4], t)])
+    round_keys = [sum(words[4 * r:4 * r + 4], []) for r in range(11)]
+
+    state = [b ^ k for b, k in zip(block, round_keys[0])]
+
+    def shift_rows(s):
+        return [s[(p % 4) + 4 * ((p // 4 + p % 4) % 4)] for p in range(16)]
+
+    def mix_columns(s):
+        out = [0] * 16
+        for c in range(4):
+            col = s[4 * c:4 * c + 4]
+            for r in range(4):
+                out[4 * c + r] = (
+                    gmul(2, col[r])
+                    ^ gmul(3, col[(r + 1) % 4])
+                    ^ col[(r + 2) % 4]
+                    ^ col[(r + 3) % 4]
+                )
+        return out
+
+    for r in range(1, 10):
+        state = [int(SBOX[b]) for b in state]
+        state = shift_rows(state)
+        state = mix_columns(state)
+        state = [b ^ k for b, k in zip(state, round_keys[r])]
+    state = [int(SBOX[b]) for b in state]
+    state = shift_rows(state)
+    state = [b ^ k for b, k in zip(state, round_keys[10])]
+    return bytes(state)
+
+
+# AES state is column-major: input byte p holds state[row=p%4][col=p//4]
+# (FIPS-197 §3.4); ShiftRows is the position permutation below.
+
+def _shift_rows_perm() -> list:
+    # out position p=(r,c) takes in position (r, (c+r)%4)
+    return [(p % 4) + 4 * ((p // 4 + p % 4) % 4) for p in range(16)]
+
+
+# ---------------------------------------------------------------------------
+# Linear bit-matrices (derived numerically; planes are MSB-first)
+# ---------------------------------------------------------------------------
+
+
+def _matrix_of(f) -> np.ndarray:
+    """8x8 bit matrix M with out_plane_i = XOR_{j: M[i,j]} in_plane_j,
+    planes MSB-first (plane i = bit weight 2^(7-i))."""
+    M = np.zeros((8, 8), dtype=np.uint8)
+    for j in range(8):
+        y = f(1 << (7 - j))
+        for i in range(8):
+            M[i, j] = (y >> (7 - i)) & 1
+    return M
+
+
+_SQUARE_M = _matrix_of(lambda x: gmul(x, x))
+_AFFINE_M = _matrix_of(lambda x: _affine(x) ^ 0x63)  # linear part only
+_AFFINE_C = 0x63
+# x^e mod poly for e in 8..14, as byte values (reduction of high product
+# coefficients in the bilinear multiply)
+_REDUCE = {e: _gpow(2, e) for e in range(8, 15)}
+
+
+# ---------------------------------------------------------------------------
+# Bit-circuit backends: same op surface over host bits and replicated bits
+# ---------------------------------------------------------------------------
+
+
+class HostBitOps:
+    def __init__(self, sess, plc: str):
+        self.sess = sess
+        self.plc = plc
+
+    def xor(self, x, y):
+        return self.sess.xor(self.plc, x, y)
+
+    def and_(self, x, y):
+        return self.sess.and_(self.plc, x, y)
+
+    def not_(self, x):
+        return self.sess.bit_neg(self.plc, x)
+
+    def expand0(self, x, axis):
+        return self.sess.expand_dims(self.plc, x, axis)
+
+    def concat0(self, xs):
+        return self.sess.concat(self.plc, xs, 0)
+
+    def stack(self, xs):
+        return self.concat0([self.expand0(x, 0) for x in xs])
+
+    def slice0(self, x, b, e):
+        return self.sess.strided_slice(self.plc, x, (slice(b, e),))
+
+    def take0(self, x, idx):
+        return self.concat0([self.slice0(x, i, i + 1) for i in idx])
+
+    def index2(self, x, i, j):
+        y = self.sess.index_axis(self.plc, x, 0, i)
+        return self.sess.index_axis(self.plc, y, 0, j)
+
+    def _ndim(self, x) -> int:
+        return x.value.ndim
+
+    def xor_public(self, x, mask: np.ndarray):
+        m = mask.reshape(mask.shape + (1,) * (self._ndim(x) - mask.ndim))
+        c = self.sess.constant(self.plc, m.astype(bool))
+        return self.sess.xor(self.plc, x, c)
+
+    def compose_ring128(self, bits):
+        """bits: leading axis 128, index i = weight 2^i."""
+        return self.sess.compose_bits(self.plc, bits, 128)
+
+
+class RepBitOps:
+    def __init__(self, sess, rep):
+        self.sess = sess
+        self.rep = rep
+
+    def xor(self, x, y):
+        return rep_ops.xor(self.sess, self.rep, x, y)
+
+    def and_(self, x, y):
+        return rep_ops.and_bits(self.sess, self.rep, x, y)
+
+    def not_(self, x):
+        return rep_ops.neg_bits(self.sess, self.rep, x)
+
+    def expand0(self, x, axis):
+        return rep_ops.expand_dims(self.sess, self.rep, x, axis)
+
+    def concat0(self, xs):
+        return rep_ops.concat(self.sess, self.rep, xs, 0)
+
+    def stack(self, xs):
+        return self.concat0([self.expand0(x, 0) for x in xs])
+
+    def slice0(self, x, b, e):
+        return rep_ops.strided_slice(self.sess, self.rep, x, (slice(b, e),))
+
+    def take0(self, x, idx):
+        return self.concat0([self.slice0(x, i, i + 1) for i in idx])
+
+    def index2(self, x, i, j):
+        y = rep_ops.index_axis(self.sess, self.rep, x, 0, i)
+        return rep_ops.index_axis(self.sess, self.rep, y, 0, j)
+
+    def _ndim(self, x) -> int:
+        return x.shares[0][0].value.ndim
+
+    def xor_public(self, x, mask: np.ndarray):
+        """XOR with a public constant: applied to share x_0 — held by
+        party 0 (first slot) and party 2 (second slot) — mirroring
+        neg_bits."""
+        m = mask.reshape(mask.shape + (1,) * (self._ndim(x) - mask.ndim))
+        p = self.rep.owners
+        s = x.shares
+        c0 = self.sess.constant(p[0], m.astype(bool))
+        c2 = self.sess.constant(p[2], m.astype(bool))
+        return RepTensor(
+            (
+                (self.sess.xor(p[0], s[0][0], c0), s[0][1]),
+                s[1],
+                (s[2][0], self.sess.xor(p[2], s[2][1], c2)),
+            ),
+            self.rep.name,
+        )
+
+    def compose_ring128(self, bits):
+        return rep_ops.bit_compose(self.sess, self.rep, bits, 128)
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane circuit
+# ---------------------------------------------------------------------------
+
+
+def _linear(B, planes, M: np.ndarray):
+    out = []
+    for i in range(8):
+        acc = None
+        for j in range(8):
+            if M[i, j]:
+                acc = planes[j] if acc is None else B.xor(acc, planes[j])
+        if acc is None:
+            raise KernelError("degenerate linear layer (zero row)")
+        out.append(acc)
+    return out
+
+
+def _xor_const_planes(B, planes, byte: int):
+    return [
+        B.not_(p) if (byte >> (7 - i)) & 1 else p
+        for i, p in enumerate(planes)
+    ]
+
+
+def _gf_mul(B, a_planes, b_planes):
+    """One GF(2^8) multiplication on bit planes: a single broadcasted AND
+    of shape (8, 8, N, ...) + XOR folds + linear reduction."""
+    A = B.expand0(B.stack(a_planes), 1)  # (8, 1, N, ...)
+    Bv = B.expand0(B.stack(b_planes), 0)  # (1, 8, N, ...)
+    prod = B.and_(A, Bv)  # (8, 8, N, ...)
+    coeffs: dict[int, list] = {}
+    for i in range(8):
+        for j in range(8):
+            e = 14 - i - j  # plane i <-> exponent 7-i
+            coeffs.setdefault(e, []).append((i, j))
+    c = {}
+    for e, pairs in coeffs.items():
+        acc = None
+        for (i, j) in pairs:
+            t = B.index2(prod, i, j)
+            acc = t if acc is None else B.xor(acc, t)
+        c[e] = acc
+    out = [c[7 - i] for i in range(8)]  # low coefficients, MSB-first planes
+    for e in range(8, 15):
+        r = _REDUCE[e]
+        for i in range(8):
+            if (r >> (7 - i)) & 1:
+                out[i] = B.xor(out[i], c[e])
+    return out
+
+
+def _sub_bytes(B, planes):
+    """S-box on every byte of the plane set (any leading byte count)."""
+    sq = lambda p: _linear(B, p, _SQUARE_M)
+    x2 = sq(planes)
+    x3 = _gf_mul(B, x2, planes)
+    x12 = sq(sq(x3))
+    x15 = _gf_mul(B, x12, x3)
+    x240 = sq(sq(sq(sq(x15))))
+    x252 = _gf_mul(B, x240, x12)
+    x254 = _gf_mul(B, x252, x2)
+    out = _linear(B, x254, _AFFINE_M)
+    return _xor_const_planes(B, out, _AFFINE_C)
+
+
+def _bits_to_planes(B, bits, n_bytes: int):
+    return [
+        B.take0(bits, [8 * b + j for b in range(n_bytes)]) for j in range(8)
+    ]
+
+
+def _planes_to_bits(B, planes, n_bytes: int):
+    pieces = []
+    for b in range(n_bytes):
+        for j in range(8):
+            pieces.append(B.slice0(planes[j], b, b + 1))
+    return B.concat0(pieces)
+
+
+def _xtime(B, planes):
+    t2 = [None] * 8
+    for i in range(7):
+        t2[i] = planes[i + 1]
+    msb = planes[0]
+    for i in range(8):
+        if (0x1B >> (7 - i)) & 1:
+            t2[i] = msb if t2[i] is None else B.xor(t2[i], msb)
+    if t2[7] is None:  # 0x1B has bit 7 set, so this cannot happen
+        raise KernelError("xtime fold lost the carry bit")
+    return t2
+
+
+def _shift_rows(B, planes):
+    perm = _shift_rows_perm()
+    return [B.take0(p, perm) for p in planes]
+
+
+def _mix_columns(B, planes):
+    t2 = _xtime(B, planes)
+    t3 = [B.xor(a, b) for a, b in zip(t2, planes)]
+
+    def perm_k(k):
+        return [(p % 4 + k) % 4 + 4 * (p // 4) for p in range(16)]
+
+    p1, p2, p3 = perm_k(1), perm_k(2), perm_k(3)
+    out = []
+    for i in range(8):
+        acc = t2[i]
+        acc = B.xor(acc, B.take0(t3[i], p1))
+        acc = B.xor(acc, B.take0(planes[i], p2))
+        acc = B.xor(acc, B.take0(planes[i], p3))
+        out.append(acc)
+    return out
+
+
+def _key_schedule(B, key_planes):
+    round_keys = [key_planes]
+    prev = key_planes
+    for r in range(1, 11):
+        last = [B.take0(p, [12, 13, 14, 15]) for p in prev]
+        rot = [B.take0(p, [1, 2, 3, 0]) for p in last]
+        sub = _sub_bytes(B, rot)
+        words = []
+        w_prev = [
+            [B.take0(p, [4 * w + b for b in range(4)]) for p in prev]
+            for w in range(4)
+        ]
+        # rcon xor hits byte 0 only: flip plane i at position 0 where
+        # bit i of RC[r] is set
+        rc = RCON[r - 1]
+        byte0 = np.array([1, 0, 0, 0], np.uint8)
+        t = [
+            B.xor_public(p, byte0) if (rc >> (7 - i)) & 1 else p
+            for i, p in enumerate(sub)
+        ]
+        w = [B.xor(a, b) for a, b in zip(w_prev[0], t)]
+        words.append(w)
+        for k in range(1, 4):
+            w = [B.xor(a, b) for a, b in zip(w_prev[k], words[k - 1])]
+            words.append(w)
+        rk = [
+            B.concat0([words[w][i] for w in range(4)]) for i in range(8)
+        ]
+        round_keys.append(rk)
+        prev = rk
+    return round_keys
+
+
+def aes128_encrypt_block(B, key_bits, block_bits):
+    """AES-128 on bit values with leading axis 128 (bit 8b+j = byte b,
+    bit j MSB-first).  ``B`` is a HostBitOps or RepBitOps backend."""
+    kp = _bits_to_planes(B, key_bits, 16)
+    sp = _bits_to_planes(B, block_bits, 16)
+    rks = _key_schedule(B, kp)
+    ark = lambda s, k: [B.xor(a, b) for a, b in zip(s, k)]
+    state = ark(sp, rks[0])
+    for r in range(1, 10):
+        state = _sub_bytes(B, state)
+        state = _shift_rows(B, state)
+        state = _mix_columns(B, state)
+        state = ark(state, rks[r])
+    state = _sub_bytes(B, state)
+    state = _shift_rows(B, state)
+    state = ark(state, rks[10])
+    return _planes_to_bits(B, state, 16)
+
+
+def aesgcm_decrypt_block(B, key_bits, nonce_bits, cipher_bits):
+    """Recover the ring128 plaintext of one AES-GCM block
+    (encrypted/ops.rs aesgcm): keystream = AES(key, nonce ‖ ctr=2);
+    m = c ⊕ keystream; compose MSB-first bits into Z_{2^128}."""
+    # one key encrypts every element: align the key's element rank with
+    # the ciphertext's so plane XORs broadcast (bit axis leads)
+    for _ in range(B._ndim(cipher_bits) - B._ndim(key_bits)):
+        key_bits = B.expand0(key_bits, -1)
+    # counter block: 96 nonce bits, then the 32-bit counter value 2
+    # (bit index 126 set)
+    ctr_mask = np.zeros(32, dtype=np.uint8)
+    ctr_mask[30] = 1  # bit 126 of the block
+    zeros32 = B.slice0(nonce_bits, 0, 32)
+    zeros32 = B.xor(zeros32, zeros32)  # 32 zero bit-planes of element shape
+    ctr_bits = B.xor_public(zeros32, ctr_mask)
+    block_bits = B.concat0([nonce_bits, ctr_bits])
+    r_bits = aes128_encrypt_block(B, key_bits, block_bits)
+    m_bits = B.xor(cipher_bits, r_bits)
+    # bit index i has weight 2^(127-i): reverse, then compose
+    m_rev = B.take0(m_bits, list(range(127, -1, -1)))
+    return B.compose_ring128(m_rev)
+
+
+# ---------------------------------------------------------------------------
+# Logical-dialect entry points (called from logical.py Decrypt dispatch)
+# ---------------------------------------------------------------------------
+
+
+def _ret_precision(op):
+    dtype = op.signature.return_type.dtype
+    if dtype is None or not dtype.is_fixedpoint:
+        raise TypeMismatchError(
+            f"Decrypt {op.name}: return dtype must be fixed-point, found "
+            f"{dtype}"
+        )
+    return dtype.integral_precision, dtype.fractional_precision
+
+
+def decrypt_host(sess, h: str, key, ciphertext, op) -> HostFixedTensor:
+    """Decrypt on a host placement (encrypted/ops.rs host_kernel): a
+    replicated key is revealed to the host first."""
+    from . import logical
+
+    if isinstance(key, RepAesKey):
+        rep = logical._rep_placement_of(sess, key.plc)
+        bits = rep_ops.reveal(sess, rep, key.bits.tensor, h)
+    elif isinstance(key, HostAesKey):
+        bits = sess.place(h, key.bits)
+    else:
+        raise TypeMismatchError(f"Decrypt key: {type(key).__name__}")
+    if not isinstance(ciphertext, AesTensor):
+        raise TypeMismatchError(
+            f"Decrypt ciphertext: {type(ciphertext).__name__}"
+        )
+    B = HostBitOps(sess, h)
+    ring = aesgcm_decrypt_block(
+        B,
+        bits,
+        sess.place(h, ciphertext.nonce_bits),
+        sess.place(h, ciphertext.cipher_bits),
+    )
+    integ, frac = _ret_precision(op)
+    return HostFixedTensor(ring, integ, frac)
+
+
+def decrypt_rep(sess, rep, key, ciphertext, op) -> RepFixedTensor:
+    """Decrypt under MPC (encrypted/ops.rs rep_kernel): the plaintext is
+    never revealed — the ciphertext bits are shared and AES runs on
+    replicated bit shares; a host key is shared first."""
+    if isinstance(key, HostAesKey):
+        key_bits = rep_ops.share(sess, rep, key.bits)
+    elif isinstance(key, RepAesKey):
+        key_bits = key.bits.tensor
+    else:
+        raise TypeMismatchError(f"Decrypt key: {type(key).__name__}")
+    if not isinstance(ciphertext, AesTensor):
+        raise TypeMismatchError(
+            f"Decrypt ciphertext: {type(ciphertext).__name__}"
+        )
+    nonce = rep_ops.share(sess, rep, ciphertext.nonce_bits)
+    cipher = rep_ops.share(sess, rep, ciphertext.cipher_bits)
+    B = RepBitOps(sess, rep)
+    ring = aesgcm_decrypt_block(B, key_bits, nonce, cipher)
+    integ, frac = _ret_precision(op)
+    return RepFixedTensor(ring, integ, frac)
+
+
+# ---------------------------------------------------------------------------
+# Host-side data preparation helpers (the reference prepares these with the
+# aes-gcm crate in its tests; users bring ciphertexts from any AES-GCM
+# implementation)
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_bits_be(data: bytes) -> np.ndarray:
+    return np.unpackbits(np.frombuffer(data, dtype=np.uint8))
+
+
+def encrypt_fixed_array(
+    key: bytes, nonce: bytes, values: np.ndarray, frac_precision: int
+) -> np.ndarray:
+    """AES-GCM-encrypt a float array elementwise into the wire format of
+    AesTensor inputs: uint8 bits of shape (224,) + values.shape (96 nonce
+    bits ‖ 128 masked-plaintext bits per element).
+
+    Each element is encoded as a two's-complement fixed-point 128-bit
+    integer and masked with the keystream block AES(key, nonce ‖ ctr=2) —
+    one element per (nonce, block); for multi-element arrays, per-element
+    nonces are derived by XORing the element index into the base nonce
+    (sufficient for tests; any AES-GCM producer works).
+    """
+    assert len(key) == 16 and len(nonce) == 12
+    flat = np.asarray(values, dtype=np.float64).ravel()
+    out = np.zeros((224, flat.size), dtype=np.uint8)
+    for idx, v in enumerate(flat):
+        n = bytearray(nonce)
+        n[-4:] = (
+            int.from_bytes(nonce[-4:], "big") ^ idx
+        ).to_bytes(4, "big")
+        n = bytes(n)
+        raw = int(round(float(v) * (1 << frac_precision))) % (1 << 128)
+        block = bytearray(16)
+        block[:12] = n
+        block[15] = 2
+        keystream = aes128_encrypt_block_np(key, bytes(block))
+        masked = raw ^ int.from_bytes(keystream, "big")
+        out[:96, idx] = bytes_to_bits_be(n)
+        out[96:, idx] = bytes_to_bits_be(masked.to_bytes(16, "big"))
+    return out.reshape((224,) + np.asarray(values).shape)
+
+
+def lift_input(sess, comp, op, arr, plc):
+    """Interpreter boundary: lift a user-provided bit array into an AES
+    value (AesTensor: (224,)+shape; AesKey: (128,)+shape)."""
+    import jax.numpy as jnp
+
+    from . import logical
+
+    ret = op.signature.return_type
+    bits = jnp.asarray(np.asarray(arr)).astype(jnp.uint8)
+    plc_obj = comp.placements[plc]
+    if ret.name == "AesTensor":
+        if bits.shape[0] != 224:
+            raise KernelError(
+                f"AesTensor input {op.name}: leading axis must be 224 "
+                f"(96 nonce + 128 ciphertext bits), found {bits.shape[0]}"
+            )
+        owner = plc if plc_obj.kind == "Host" else plc_obj.owners[0]
+        return AesTensor(
+            HostBitTensor(bits[:96], owner),
+            HostBitTensor(bits[96:], owner),
+            owner,
+        )
+    if ret.name in ("AesKey", "HostAesKey", "ReplicatedAesKey"):
+        if bits.shape[0] != 128:
+            raise KernelError(
+                f"AesKey input {op.name}: leading axis must be 128, found "
+                f"{bits.shape[0]}"
+            )
+        if plc_obj.kind == "Host":
+            return HostAesKey(HostBitTensor(bits, plc), plc)
+        if plc_obj.kind == "Replicated":
+            # the key arrives as cleartext bits in the local runtime; it is
+            # shared from the first owner (the reference's replicated-Input
+            # AES key is likewise provided by the session arguments)
+            host_bits = HostBitTensor(bits, plc_obj.owners[0])
+            shared = rep_ops.share(sess, plc_obj, host_bits)
+            return RepAesKey(RepBitArray(shared, 128))
+    raise TypeMismatchError(f"cannot lift AES input of type {ret.name}")
