@@ -1,0 +1,68 @@
+"""comet: worker daemon — gRPC choreography + gRPC networking + filesystem
+storage (reference ``moose/src/bin/comet/comet.rs:12-83``).
+
+  python -m moose_tpu.bin.comet --identity alice --port 50001 \
+      --endpoints alice=localhost:50001,bob=localhost:50002,carole=localhost:50003 \
+      [--storage-dir /data/alice]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+
+def parse_endpoints(spec: str) -> dict:
+    out = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        name, _, endpoint = part.partition("=")
+        out[name.strip()] = endpoint.strip()
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="comet", description=__doc__)
+    parser.add_argument(
+        "--identity", required=True,
+        default=os.environ.get("MOOSE_IDENTITY"),
+    )
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument(
+        "--endpoints", required=True,
+        help="identity=host:port,... for every worker (gRPC networking "
+        "peer table)",
+    )
+    parser.add_argument(
+        "--storage-dir", default=None,
+        help="directory for .npy/.csv filesystem storage (in-memory dict "
+        "if omitted)",
+    )
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from moose_tpu.distributed.choreography import WorkerServer
+
+    storage = None
+    if args.storage_dir:
+        from moose_tpu.storage import FilesystemStorage
+
+        storage = FilesystemStorage(args.storage_dir)
+    server = WorkerServer(
+        args.identity, args.port, parse_endpoints(args.endpoints),
+        storage=storage,
+    ).start()
+    logging.getLogger("comet").info(
+        "worker %s listening on port %d", args.identity, server.port
+    )
+    server.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""cometctl: cluster control — launch/retrieve/abort/run sessions against
+comet workers (reference ``moose/src/bin/comet/cometctl.rs:30-145``).
+
+Session files are TOML (reference .session format):
+
+    session_id = "my-session"
+    [computation]
+    path = "comp.moose"        # textual or msgpack
+    [roles]
+    alice = "localhost:50001"
+    bob = "localhost:50002"
+    carole = "localhost:50003"
+
+  python -m moose_tpu.bin.cometctl run session.toml --args args.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import secrets
+import sys
+import tomllib
+from pathlib import Path
+
+import numpy as np
+
+
+def _load_session(path: str):
+    cfg = tomllib.loads(Path(path).read_text())
+    comp_path = cfg["computation"]["path"]
+    data = Path(comp_path).read_bytes()
+    from moose_tpu.serde import deserialize_computation
+    from moose_tpu.textual import parse_computation
+
+    if comp_path.endswith((".moose", ".txt")) or data[:1].isalpha():
+        comp = parse_computation(data.decode())
+    else:
+        comp = deserialize_computation(data)
+    session_id = cfg.get("session_id") or secrets.token_hex(8)
+    return session_id, comp, dict(cfg["roles"])
+
+
+def _load_args(path):
+    if path is None:
+        return {}
+    raw = json.loads(Path(path).read_text())
+    return {
+        k: (v if isinstance(v, (str, int, float)) else np.asarray(v))
+        for k, v in raw.items()
+    }
+
+
+def cmd_run(args):
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    session_id, comp, roles = _load_session(args.session)
+    runtime = GrpcClientRuntime(roles)
+    outputs, timings = runtime.run_computation(
+        comp, _load_args(args.args)
+    )
+    for role, micros in sorted(timings.items()):
+        print(f"# {role}: {micros} us", file=sys.stderr)
+    if args.json:
+        print(json.dumps({
+            name: (None if value is None
+                   else np.asarray(value).tolist())
+            for name, value in outputs.items()
+        }))
+        return
+    for name, value in outputs.items():
+        print(name, "=", None if value is None else np.asarray(value))
+
+
+def cmd_abort(args):
+    from moose_tpu.distributed.choreography import ChoreographyClient
+
+    session_id, _, roles = _load_session(args.session)
+    for role, endpoint in roles.items():
+        ChoreographyClient(endpoint).abort(session_id)
+        print(f"aborted {session_id} on {role}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="cometctl", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_run = sub.add_parser("run", help="launch + retrieve a session")
+    p_run.add_argument("session")
+    p_run.add_argument("--args", default=None, help="JSON arguments file")
+    p_run.add_argument("--json", action="store_true",
+                       help="print outputs as one JSON object")
+    p_run.set_defaults(fn=cmd_run)
+    p_abort = sub.add_parser("abort", help="abort a session")
+    p_abort.add_argument("session")
+    p_abort.set_defaults(fn=cmd_abort)
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

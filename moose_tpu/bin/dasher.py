@@ -1,0 +1,82 @@
+"""dasher: single-process simulator of all roles — every identity runs as
+a thread over in-memory networking (reference ``moose/src/bin/dasher``).
+
+  python -m moose_tpu.bin.dasher comp.moose --args args.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="dasher", description=__doc__)
+    parser.add_argument("computation")
+    parser.add_argument("--args", default=None)
+    parser.add_argument(
+        "--passes", default="typing,lowering,prune,networking,toposort"
+    )
+    args = parser.parse_args(argv)
+
+    from moose_tpu.compilation import compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.computation import HostPlacement
+    from moose_tpu.distributed.networking import LocalNetworking
+    from moose_tpu.distributed.worker import execute_role
+    from moose_tpu.serde import deserialize_computation
+    from moose_tpu.textual import parse_computation
+
+    data = Path(args.computation).read_bytes()
+    if args.computation.endswith((".moose", ".txt")) or data[:1].isalpha():
+        comp = parse_computation(data.decode())
+    else:
+        comp = deserialize_computation(data)
+
+    arguments = {}
+    if args.args:
+        raw = json.loads(Path(args.args).read_text())
+        arguments = {
+            k: (v if isinstance(v, (str, int, float)) else np.asarray(v))
+            for k, v in raw.items()
+        }
+
+    passes = [p for p in args.passes.split(",") if p]
+    if passes:
+        comp = compile_computation(
+            comp, passes, arg_specs=arg_specs_from_arguments(arguments)
+        )
+
+    identities = sorted(
+        p.name
+        for p in comp.placements.values()
+        if isinstance(p, HostPlacement)
+    )
+    net = LocalNetworking()
+    results: dict = {}
+
+    def work(identity):
+        results[identity] = execute_role(
+            comp, identity, {}, arguments, net, session_id="dasher"
+        )
+
+    threads = [
+        threading.Thread(target=work, args=(i,)) for i in identities
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for identity in identities:
+        r = results[identity]
+        print(f"# {identity}: {r['elapsed_time_micros']} us")
+        for name, value in r["outputs"].items():
+            print(name, "=", value)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""rudolph: worker with filesystem choreography + gRPC networking
+(reference ``moose/src/bin/rudolph/main.rs`` +
+``choreography/filesystem.rs:28-259``): watches a directory for
+``*.session`` TOML files and launches each session it finds.
+
+  python -m moose_tpu.bin.rudolph --identity alice --port 50001 \
+      --sessions-dir ./sessions [--poll-interval 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+import tomllib
+from pathlib import Path
+
+import numpy as np
+
+
+def _launch_from_file(server, path: Path, log):
+    cfg = tomllib.loads(path.read_text())
+    session_id = cfg.get("session_id") or path.stem
+    comp_path = (path.parent / cfg["computation"]["path"]).resolve()
+    data = comp_path.read_bytes()
+    from moose_tpu.serde import (
+        deserialize_computation,
+        serialize_computation,
+    )
+    from moose_tpu.textual import parse_computation
+
+    if str(comp_path).endswith((".moose", ".txt")) or data[:1].isalpha():
+        comp_bytes = serialize_computation(
+            parse_computation(data.decode())
+        )
+    else:
+        comp_bytes = data
+    roles = dict(cfg["roles"])
+    server.endpoints.update(roles)
+    server.networking._endpoints.update(roles)
+    arguments = {}
+    args_path = cfg.get("arguments")
+    if args_path:
+        import json
+
+        raw = json.loads((path.parent / args_path).read_text())
+        arguments = {
+            k: (v if isinstance(v, (str, int, float)) else np.asarray(v))
+            for k, v in raw.items()
+        }
+    import msgpack
+
+    from moose_tpu.serde import serialize_value
+
+    server._launch(
+        msgpack.packb(
+            {
+                "session_id": session_id,
+                "computation": comp_bytes,
+                "arguments": {
+                    k: serialize_value(v) for k, v in arguments.items()
+                },
+            },
+            use_bin_type=True,
+        )
+    )
+    log.info("launched session %s from %s", session_id, path.name)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="rudolph", description=__doc__)
+    parser.add_argument("--identity", required=True)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--sessions-dir", required=True)
+    parser.add_argument("--poll-interval", type=float, default=1.0)
+    parser.add_argument("--storage-dir", default=None)
+    parser.add_argument("--once", action="store_true",
+                        help="scan once and exit (tests)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("rudolph")
+
+    from moose_tpu.distributed.choreography import WorkerServer
+
+    storage = None
+    if args.storage_dir:
+        from moose_tpu.storage import FilesystemStorage
+
+        storage = FilesystemStorage(args.storage_dir)
+    server = WorkerServer(
+        args.identity, args.port, {}, storage=storage
+    ).start()
+    log.info("worker %s on port %d watching %s", args.identity,
+             server.port, args.sessions_dir)
+
+    seen: set = set()
+    sessions_dir = Path(args.sessions_dir)
+    while True:
+        for path in sorted(sessions_dir.glob("*.session")):
+            stamp = (path.name, path.stat().st_mtime_ns)
+            if stamp in seen:
+                continue
+            seen.add(stamp)
+            try:
+                _launch_from_file(server, path, log)
+            except Exception as e:
+                log.error("failed to launch %s: %s", path.name, e)
+        if args.once:
+            break
+        time.sleep(args.poll_interval)
+
+
+if __name__ == "__main__":
+    main()

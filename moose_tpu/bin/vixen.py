@@ -1,0 +1,86 @@
+"""vixen: single-session worker over raw TCP networking (reference
+``moose/src/bin/vixen/main.rs``) — one process per identity, role
+assignment from flags, executes one computation and prints its outputs.
+
+  python -m moose_tpu.bin.vixen --identity alice \
+      --endpoints alice=127.0.0.1:21401,bob=127.0.0.1:21402,carole=127.0.0.1:21403 \
+      --session-id s1 comp.moose --args args.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import numpy as np
+
+from .comet import parse_endpoints
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="vixen", description=__doc__)
+    parser.add_argument("computation")
+    parser.add_argument("--identity", required=True)
+    parser.add_argument("--endpoints", required=True)
+    parser.add_argument("--session-id", default="vixen")
+    parser.add_argument("--args", default=None)
+    parser.add_argument(
+        "--passes", default="typing,lowering,prune,networking,toposort",
+        help="set to '' if the computation is already lowered",
+    )
+    parser.add_argument("--storage-dir", default=None)
+    args = parser.parse_args(argv)
+
+    from moose_tpu.compilation import compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.distributed.networking import TcpNetworking
+    from moose_tpu.distributed.worker import execute_role
+    from moose_tpu.serde import deserialize_computation
+    from moose_tpu.textual import parse_computation
+
+    data = Path(args.computation).read_bytes()
+    if args.computation.endswith((".moose", ".txt")) or data[:1].isalpha():
+        comp = parse_computation(data.decode())
+    else:
+        comp = deserialize_computation(data)
+
+    arguments = {}
+    if args.args:
+        raw = json.loads(Path(args.args).read_text())
+        arguments = {
+            k: (v if isinstance(v, (str, int, float)) else np.asarray(v))
+            for k, v in raw.items()
+        }
+
+    passes = [p for p in args.passes.split(",") if p]
+    if passes:
+        # NOTE: lowering samples fresh rendezvous nonces, so all vixen
+        # processes of one session must receive the SAME lowered graph —
+        # pre-compile with elk and pass --passes '' for multi-process runs;
+        # in-process compilation is only deterministic for single tests.
+        comp = compile_computation(
+            comp, passes, arg_specs=arg_specs_from_arguments(arguments)
+        )
+
+    storage: dict = {}
+    if args.storage_dir:
+        from moose_tpu.storage import FilesystemStorage
+
+        storage = FilesystemStorage(args.storage_dir)
+
+    net = TcpNetworking(args.identity, parse_endpoints(args.endpoints))
+    net.start()
+    try:
+        result = execute_role(
+            comp, args.identity, storage, arguments, net, args.session_id
+        )
+    finally:
+        net.stop()
+    print(f"# {args.identity}: {result['elapsed_time_micros']} us")
+    for name, value in result["outputs"].items():
+        print(name, "=", value)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""Distributed execution: networking backends, role-filtered workers,
+choreography, and the client runtime (reference ``moose/src/networking``,
+``moose/src/choreography``, ``moose/src/execution/grpc.rs``)."""

@@ -1,0 +1,198 @@
+"""Choreography: session orchestration across workers over gRPC.
+
+Reference ``moose/src/choreography/grpc.rs:34-234`` +
+``protos/choreography.proto``: LaunchComputation / RetrieveResults /
+AbortComputation, with per-session result cells and duplicate-session
+protection.  gRPC methods carry raw msgpack bytes (no protoc codegen
+needed; the reference uses tonic+prost — the method *names* and semantics
+match, the payload codec is msgpack like the rest of this framework).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Optional
+
+import msgpack
+
+from ..errors import NetworkingError, SessionAlreadyExistsError
+from .networking import GrpcNetworking, _CellStore
+
+LAUNCH = "/moose.Choreography/LaunchComputation"
+RETRIEVE = "/moose.Choreography/RetrieveResults"
+ABORT = "/moose.Choreography/AbortComputation"
+SEND_VALUE = "/moose.Networking/SendValue"
+
+
+def _pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(data: bytes):
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+class WorkerServer:
+    """One worker daemon: hosts the choreography service and the gRPC
+    networking endpoint, executes its role of launched sessions in
+    background threads (reference comet, bin/comet/comet.rs:12-83)."""
+
+    def __init__(self, identity: str, port: int, endpoints: dict,
+                 storage: Optional[dict] = None):
+        self.identity = identity
+        self.port = port
+        self.endpoints = dict(endpoints)
+        self.storage = storage if storage is not None else {}
+        self.networking = GrpcNetworking(identity, self.endpoints)
+        self._sessions: dict = {}
+        self._results = _CellStore()
+        self._lock = threading.Lock()
+        self._server = None
+
+    # -- rpc handlers ---------------------------------------------------
+
+    def _launch(self, request: bytes) -> bytes:
+        from ..serde import deserialize_computation, deserialize_value
+
+        msg = _unpack(request)
+        session_id = msg["session_id"]
+        with self._lock:
+            if session_id in self._sessions:
+                raise SessionAlreadyExistsError(session_id)
+            self._sessions[session_id] = "running"
+        comp = deserialize_computation(msg["computation"])
+        arguments = {
+            name: deserialize_value(blob)
+            for name, blob in (msg.get("arguments") or {}).items()
+        }
+
+        def run():
+            from .worker import execute_role
+
+            try:
+                result = execute_role(
+                    comp, self.identity, self.storage, arguments,
+                    self.networking, session_id,
+                )
+                outputs = {
+                    name: _serialize_output(value)
+                    for name, value in result["outputs"].items()
+                }
+                self._results.put(
+                    session_id,
+                    _pack({
+                        "outputs": outputs,
+                        "elapsed_time_micros": result[
+                            "elapsed_time_micros"
+                        ],
+                    }),
+                )
+            except Exception as e:  # surfaced on retrieve
+                self._results.put(
+                    session_id, _pack({"error": f"{type(e).__name__}: {e}"})
+                )
+
+        threading.Thread(target=run, daemon=True).start()
+        return _pack({"ok": True})
+
+    def _retrieve(self, request: bytes) -> bytes:
+        msg = _unpack(request)
+        timeout = float(msg.get("timeout", 120.0))
+        return self._results.get(msg["session_id"], timeout)
+
+    def _abort(self, request: bytes) -> bytes:
+        msg = _unpack(request)
+        with self._lock:
+            self._sessions.pop(msg["session_id"], None)
+        # fail-stop semantics: mark the result cell so retrievers unblock
+        self._results.put(msg["session_id"], _pack({"error": "aborted"}))
+        return _pack({"ok": True})
+
+    def _send_value(self, request: bytes) -> bytes:
+        return self.networking.handle_send_value(request)
+
+    # -- server lifecycle ----------------------------------------------
+
+    def start(self):
+        import grpc
+
+        def unary(fn):
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: fn(req),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+
+        handlers = {
+            "LaunchComputation": unary(self._launch),
+            "RetrieveResults": unary(self._retrieve),
+            "AbortComputation": unary(self._abort),
+        }
+        net_handlers = {"SendValue": unary(self._send_value)}
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16)
+        )
+        self._server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "moose.Choreography", handlers
+                ),
+                grpc.method_handlers_generic_handler(
+                    "moose.Networking", net_handlers
+                ),
+            )
+        )
+        bound = self._server.add_insecure_port(f"[::]:{self.port}")
+        if bound == 0:
+            raise NetworkingError(f"cannot bind gRPC port {self.port}")
+        self.port = bound
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 0.5):
+        if self._server is not None:
+            self._server.stop(grace)
+            self._server = None
+
+    def wait(self):
+        self._server.wait_for_termination()
+
+
+def _serialize_output(value) -> bytes:
+    from ..serde import serialize_value
+
+    return serialize_value(value)
+
+
+class ChoreographyClient:
+    """Client stub for one worker (reference GrpcMooseRuntime fan-out,
+    execution/grpc.rs:57-84)."""
+
+    def __init__(self, endpoint: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(endpoint)
+
+    def launch(self, session_id: str, comp_bytes: bytes,
+               arguments: dict):
+        from ..serde import serialize_value
+
+        payload = _pack({
+            "session_id": session_id,
+            "computation": comp_bytes,
+            "arguments": {
+                name: serialize_value(v) for name, v in arguments.items()
+            },
+        })
+        fn = self._channel.unary_unary(LAUNCH)
+        return _unpack(fn(payload, timeout=30.0))
+
+    def retrieve(self, session_id: str, timeout: float = 120.0):
+        fn = self._channel.unary_unary(RETRIEVE)
+        payload = _pack({"session_id": session_id, "timeout": timeout})
+        return _unpack(fn(payload, timeout=timeout + 10.0))
+
+    def abort(self, session_id: str):
+        fn = self._channel.unary_unary(ABORT)
+        return _unpack(fn(_pack({"session_id": session_id}), timeout=10.0))

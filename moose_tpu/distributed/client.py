@@ -1,0 +1,84 @@
+"""Client runtime for a cluster of gRPC workers (reference
+GrpcMooseRuntime, execution/grpc.rs:11-146): compile the logical
+computation to the host-level graph, fan LaunchComputation out to every
+worker, retrieve + merge results and per-role timings."""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+import numpy as np
+
+from ..computation import Computation
+from ..errors import NetworkingError
+from .choreography import ChoreographyClient
+
+
+class GrpcClientRuntime:
+    def __init__(self, identities: dict):
+        """``identities``: {identity/placement name: "host:port"}."""
+        self.identities = dict(identities)
+        self._clients = {
+            name: ChoreographyClient(endpoint)
+            for name, endpoint in self.identities.items()
+        }
+
+    def run_computation(
+        self,
+        computation: Computation,
+        arguments: Optional[dict] = None,
+        timeout: float = 120.0,
+        arg_specs: Optional[dict] = None,
+    ):
+        """Compile + fan out + retrieve.  ``arg_specs`` supplies
+        shape/dtype specs the client cannot infer from ``arguments`` —
+        in particular for Load ops whose values live in worker-side
+        storage: ``{load_op_name: ((shape...), np_dtype)}``."""
+        from ..compilation import DEFAULT_PASSES, compile_computation
+        from ..compilation.lowering import arg_specs_from_arguments
+        from ..serde import (
+            deserialize_value,
+            serialize_computation,
+        )
+
+        arguments = dict(arguments or {})
+        specs = arg_specs_from_arguments(arguments)
+        specs.update(arg_specs or {})
+        compiled = compile_computation(
+            computation,
+            DEFAULT_PASSES,
+            arg_specs=specs,
+        )
+        comp_bytes = serialize_computation(compiled)
+        session_id = secrets.token_hex(16)
+
+        for name, client in self._clients.items():
+            resp = client.launch(session_id, comp_bytes, arguments)
+            if not resp.get("ok"):
+                raise NetworkingError(
+                    f"launch on {name} failed: {resp!r}"
+                )
+
+        outputs: dict = {}
+        timings: dict = {}
+        for name, client in self._clients.items():
+            result = client.retrieve(session_id, timeout=timeout)
+            if "error" in result:
+                raise NetworkingError(
+                    f"worker {name} failed: {result['error']}"
+                )
+            timings[name] = result.get("elapsed_time_micros", 0)
+            for out_name, blob in (result.get("outputs") or {}).items():
+                value = deserialize_value(blob)
+                from ..values import HostUnit
+
+                outputs[out_name] = (
+                    None if isinstance(value, HostUnit) else value
+                )
+        from ..execution.interpreter import ordered_output_names
+
+        outputs = {
+            name: outputs[name] for name in ordered_output_names(outputs)
+        }
+        return outputs, timings

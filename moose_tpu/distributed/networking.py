@@ -1,0 +1,243 @@
+"""Networking backends: value transfer keyed by (sender, receiver,
+rendezvous key, session id).
+
+Re-design of the reference's networking layer (``moose/src/networking/``):
+the same trait shape — ``send(value, receiver, rendezvous_key, session_id)``
+/ ``receive(sender, rendezvous_key, session_id)`` — with three transports:
+
+- :class:`LocalNetworking` — in-memory store for tests and the dasher
+  single-process simulator (networking/local.rs);
+- :class:`TcpNetworking` — raw length-prefixed frames over persistent TCP
+  with the framing/rendezvous store in native C++ (networking/tcpstream.rs;
+  the reference's native layer is Rust, ours is C++ via ctypes);
+- :class:`GrpcNetworking` — one ``SendValue`` rpc, out-of-order delivery
+  handled by posting receive cells before sends arrive
+  (networking/grpc.rs:25-234, protos/networking.proto).
+
+Values cross the wire as msgpack (serde.serialize_value); the reference
+uses bincode — same discipline, different codec.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..errors import NetworkingError
+
+DEFAULT_TIMEOUT_S = 120.0
+
+
+def transfer_key(session_id: str, rendezvous_key: str) -> str:
+    return f"{session_id}/{rendezvous_key}"
+
+
+class _CellStore:
+    """Rendezvous-keyed blocking cells: receive may be posted before the
+    send arrives (reference AsyncCell store, networking/grpc.rs:189-207)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: dict = {}
+        self._events: dict = {}
+
+    def put(self, key: str, value):
+        with self._lock:
+            self._values[key] = value
+            ev = self._events.get(key)
+            if ev is None:
+                ev = self._events[key] = threading.Event()
+        ev.set()
+
+    def get(self, key: str, timeout: float):
+        with self._lock:
+            ev = self._events.get(key)
+            if ev is None:
+                ev = self._events[key] = threading.Event()
+        if not ev.wait(timeout):
+            raise NetworkingError(
+                f"receive timed out after {timeout}s for {key!r}"
+            )
+        with self._lock:
+            # single-consumer: drop the cell after use (sessions never
+            # reuse a rendezvous key)
+            self._events.pop(key, None)
+            return self._values.pop(key)
+
+
+class LocalNetworking:
+    """In-memory networking shared by all virtual identities in one
+    process.  Serializes values through the real wire codec so local tests
+    exercise the same path as TCP/gRPC."""
+
+    def __init__(self, serialize: bool = True):
+        self._store = _CellStore()
+        self._serialize = serialize
+
+    def send(self, value, receiver: str, rendezvous_key: str,
+             session_id: str):
+        from ..serde import serialize_value
+
+        payload = (
+            serialize_value(value) if self._serialize else value
+        )
+        self._store.put(transfer_key(session_id, rendezvous_key), payload)
+
+    def receive(self, sender: str, rendezvous_key: str, session_id: str,
+                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S):
+        from ..serde import deserialize_value
+
+        payload = self._store.get(
+            transfer_key(session_id, rendezvous_key), timeout
+        )
+        if self._serialize:
+            return deserialize_value(payload, plc)
+        return payload
+
+
+class TcpNetworking:
+    """Raw TCP transport backed by the native C++ library
+    (moose_tpu/native/tcp_transport.cpp; reference networking/tcpstream.rs).
+
+    ``endpoints`` maps identity -> "host:port"; the local identity's server
+    must be started with :meth:`start`.
+    """
+
+    def __init__(self, identity: str, endpoints: dict):
+        from ..native import tcp
+
+        self._identity = identity
+        self._endpoints = dict(endpoints)
+        self._lib = tcp.load()
+        self._server = None
+
+    def start(self):
+        from ..native import tcp
+
+        _, port = self._endpoints[self._identity].rsplit(":", 1)
+        self._server = tcp.ServerHandle(self._lib, int(port))
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    def send(self, value, receiver: str, rendezvous_key: str,
+             session_id: str, max_retry_s: float = 30.0):
+        import time
+
+        from ..native import tcp
+        from ..serde import serialize_value
+
+        endpoint = self._endpoints.get(receiver)
+        if endpoint is None:
+            raise NetworkingError(f"unknown receiver identity {receiver!r}")
+        host, port = endpoint.rsplit(":", 1)
+        key = transfer_key(session_id, rendezvous_key)
+        payload = serialize_value(value)
+        # retry with backoff so workers may come up in any order
+        # (networking/constants.rs backoff discipline)
+        delay = 0.05
+        deadline = time.monotonic() + max_retry_s
+        while True:
+            try:
+                tcp.send(self._lib, host, int(port), key, payload)
+                return
+            except NetworkingError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+
+    def receive(self, sender: str, rendezvous_key: str, session_id: str,
+                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S):
+        from ..serde import deserialize_value
+
+        if self._server is None:
+            raise NetworkingError(
+                "TcpNetworking.receive before start(): the local server "
+                "owns the rendezvous store"
+            )
+        payload = self._server.receive(
+            transfer_key(session_id, rendezvous_key), int(timeout * 1000)
+        )
+        return deserialize_value(payload, plc)
+
+
+class GrpcNetworking:
+    """gRPC transport: a single SendValue rpc posts into the receiver's
+    cell store (reference networking/grpc.rs).  The server half is hosted
+    by the worker (see distributed.worker.WorkerServer)."""
+
+    def __init__(self, identity: str, endpoints: dict, cells: Optional[
+            _CellStore] = None):
+        self._identity = identity
+        self._endpoints = dict(endpoints)
+        self.cells = cells or _CellStore()
+        self._channels: dict = {}
+        self._lock = threading.Lock()
+
+    def _stub(self, receiver: str):
+        import grpc
+
+        with self._lock:
+            ch = self._channels.get(receiver)
+            if ch is None:
+                endpoint = self._endpoints.get(receiver)
+                if endpoint is None:
+                    raise NetworkingError(
+                        f"unknown receiver identity {receiver!r}"
+                    )
+                ch = grpc.insecure_channel(endpoint)
+                self._channels[receiver] = ch
+            return ch.unary_unary("/moose.Networking/SendValue")
+
+    def handle_send_value(self, request: bytes) -> bytes:
+        """Server-side handler: unpack (key ‖ value) frame and post it."""
+        import msgpack
+
+        frame = msgpack.unpackb(request, raw=False)
+        self.cells.put(frame["key"], frame["value"])
+        return b""
+
+    def send(self, value, receiver: str, rendezvous_key: str,
+             session_id: str):
+        import msgpack
+
+        from ..serde import serialize_value
+
+        frame = msgpack.packb(
+            {
+                "key": transfer_key(session_id, rendezvous_key),
+                "sender": self._identity,
+                "value": serialize_value(value),
+            },
+            use_bin_type=True,
+        )
+        # retry with backoff (reference networking/grpc.rs:106-112 retries
+        # for up to 5 minutes; workers may come up in any order)
+        import time
+
+        delay = 0.05
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                self._stub(receiver)(frame, timeout=10.0)
+                return
+            except Exception as e:  # grpc.RpcError
+                if time.monotonic() > deadline:
+                    raise NetworkingError(
+                        f"send to {receiver!r} failed: {e}"
+                    ) from e
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+
+    def receive(self, sender: str, rendezvous_key: str, session_id: str,
+                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S):
+        from ..serde import deserialize_value
+
+        payload = self.cells.get(
+            transfer_key(session_id, rendezvous_key), timeout
+        )
+        return deserialize_value(payload, plc)

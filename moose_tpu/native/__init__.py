@@ -1,0 +1,5 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's native layer is Rust; this framework's is C++ (compiled
+on demand with the system toolchain — the numeric path is JAX/XLA, the
+native layer carries transport/runtime plumbing)."""

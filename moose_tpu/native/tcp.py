@@ -1,0 +1,104 @@
+"""ctypes loader/wrapper for the C++ TCP transport
+(tcp_transport.cpp).  Compiles the shared library on first use with the
+system g++ and caches it next to the source."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from ..errors import NetworkingError
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "tcp_transport.cpp"
+_BUILD = _HERE / "build"
+_SO = _BUILD / "libmoose_tcp.so"
+
+_lock = threading.Lock()
+_lib = None
+
+
+def build(force: bool = False) -> Path:
+    with _lock:
+        if _SO.exists() and not force:
+            if _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+                return _SO
+        _BUILD.mkdir(exist_ok=True)
+        tmp = _SO.with_suffix(f".so.tmp{os.getpid()}")
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            str(_SRC), "-o", str(tmp),
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NetworkingError(
+                f"failed to build native TCP transport:\n{proc.stderr}"
+            )
+        os.replace(tmp, _SO)
+        return _SO
+
+
+def load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build()
+    lib = ctypes.CDLL(str(path))
+    lib.mt_server_new.restype = ctypes.c_void_p
+    lib.mt_server_new.argtypes = [ctypes.c_int]
+    lib.mt_server_free.argtypes = [ctypes.c_void_p]
+    lib.mt_send.restype = ctypes.c_int
+    lib.mt_send.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+    ]
+    lib.mt_receive.restype = ctypes.c_int
+    lib.mt_receive.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.mt_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    _lib = lib
+    return lib
+
+
+class ServerHandle:
+    def __init__(self, lib, port: int):
+        self._lib = lib
+        self._handle = lib.mt_server_new(port)
+        if not self._handle:
+            raise NetworkingError(f"cannot bind TCP server on port {port}")
+
+    def receive(self, key: str, timeout_ms: int) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.mt_receive(
+            self._handle, key.encode(), ctypes.byref(out),
+            ctypes.byref(out_len), timeout_ms,
+        )
+        if rc != 0:
+            raise NetworkingError(
+                f"TCP receive timed out ({timeout_ms} ms) for {key!r}"
+            )
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.mt_free(out)
+
+    def close(self):
+        if self._handle:
+            self._lib.mt_server_free(self._handle)
+            self._handle = None
+
+
+def send(lib, host: str, port: int, key: str, payload: bytes):
+    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+    rc = lib.mt_send(host.encode(), port, key.encode(), buf, len(payload))
+    if rc != 0:
+        raise NetworkingError(
+            f"TCP send to {host}:{port} failed (rc={rc})"
+        )

@@ -1,0 +1,90 @@
+"""Storage backends for Load/Save ops.
+
+Reference ``moose/src/storage/``: a dict-like interface with two
+implementations — the in-memory dict used by LocalMooseRuntime, and
+:class:`FilesystemStorage` persisting ``.npy`` arrays and reading ``.csv``
+tables with a JSON column query (storage/filesystem/mod.rs:18-80,
+numpy.rs, csv.rs).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from pathlib import Path
+
+import numpy as np
+
+from .errors import StorageError
+
+
+class FilesystemStorage:
+    """Maps keys to files under ``root``: ``<key>.npy`` (typed arrays,
+    save+load) or ``<key>.csv`` (load-only tables with optional JSON
+    column query, matching the reference's csv reader)."""
+
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str, suffix: str) -> Path:
+        # append (never substitute) the suffix: with_suffix would truncate
+        # dotted keys like "model.v1" and collide distinct keys
+        p = self.root / (key + suffix)
+        if self.root.resolve() not in p.resolve().parents:
+            raise StorageError(f"storage key escapes root: {key!r}")
+        return p
+
+    def __contains__(self, key: str) -> bool:
+        return (
+            self._path(key, ".npy").exists()
+            or self._path(key, ".csv").exists()
+        )
+
+    def __getitem__(self, key: str):
+        return self.load(key)
+
+    def __setitem__(self, key: str, value):
+        self.save(key, value)
+
+    def setdefault(self, key: str, default):
+        return self.load(key) if key in self else default
+
+    def load(self, key: str, query: str = ""):
+        npy = self._path(key, ".npy")
+        if npy.exists():
+            return np.load(npy, allow_pickle=False)
+        csv_path = self._path(key, ".csv")
+        if csv_path.exists():
+            return self._load_csv(csv_path, query)
+        raise StorageError(f"no value for key {key!r} in {self.root}")
+
+    def save(self, key: str, value):
+        arr = np.asarray(value)
+        if arr.dtype == object:
+            raise StorageError(
+                f"cannot persist object-dtype array under key {key!r}"
+            )
+        np.save(self._path(key, ".npy"), arr, allow_pickle=False)
+
+    def _load_csv(self, path: Path, query: str):
+        """Load a csv as float64 columns; ``query`` is the reference's
+        JSON column selector, e.g. '{"select_columns": ["x", "y"]}'."""
+        columns = None
+        if query:
+            try:
+                spec = json.loads(query)
+            except json.JSONDecodeError as e:
+                raise StorageError(f"bad csv query {query!r}: {e}") from e
+            columns = spec.get("select_columns")
+        with path.open(newline="") as f:
+            reader = csv.DictReader(f)
+            names = reader.fieldnames or []
+            use = columns if columns is not None else names
+            missing = [c for c in use if c not in names]
+            if missing:
+                raise StorageError(
+                    f"csv {path.name} has no columns {missing}"
+                )
+            rows = [[float(row[c]) for c in use] for row in reader]
+        return np.asarray(rows, dtype=np.float64)
