@@ -1,0 +1,137 @@
+"""Secure dot-product benchmarks: the reference's two tables
+(benchmarks/README.md:15-36 — sequential chains and parallel batches of
+replicated dots at several sizes), through the real user path
+(@pm.computation -> LocalMooseRuntime, whole graph fused by XLA).
+
+  python benchmarks/dot_product.py --c seq --n 100 --size 1000
+  python benchmarks/dot_product.py --all   # reproduce every table row
+"""
+
+import argparse
+import json
+import statistics
+import time
+
+import numpy as np
+
+import moose_tpu as pm
+from moose_tpu.runtime import LocalMooseRuntime
+
+alice = pm.host_placement("alice")
+bob = pm.host_placement("bob")
+carole = pm.host_placement("carole")
+rep = pm.replicated_placement(name="rep", players=[alice, bob, carole])
+
+FIXED = pm.fixed(8, 27)
+
+
+def setup_par_dot_computation(n_parallel):
+    @pm.computation
+    def dot_product_comp(
+        x_arg: pm.Argument(placement=alice, dtype=pm.float64),
+        y_arg: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x = pm.cast(x_arg, dtype=FIXED)
+        with bob:
+            y = pm.cast(y_arg, dtype=FIXED)
+        with rep:
+            x_rep = pm.identity(x)
+            y_rep = pm.identity(y)
+            z_dots = [pm.dot(x_rep, y_rep) for _ in range(n_parallel)]
+            z = pm.add_n(z_dots) if n_parallel > 1 else z_dots[0]
+        with carole:
+            res = pm.cast(z, dtype=pm.float64)
+        return res
+
+    return dot_product_comp
+
+
+def setup_seq_dot_computation(n_seq):
+    @pm.computation
+    def dot_product_comp(
+        x_arg: pm.Argument(placement=alice, dtype=pm.float64),
+        y_arg: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x = pm.cast(x_arg, dtype=FIXED)
+        with bob:
+            y = pm.cast(y_arg, dtype=FIXED)
+        with rep:
+            y_rep = pm.identity(y)
+            z = pm.dot(x, y_rep)
+            for _ in range(1, n_seq):
+                z = pm.dot(z, y_rep)
+        with carole:
+            res = pm.cast(z, dtype=pm.float64)
+        return res
+
+    return dot_product_comp
+
+
+def run_one(comp_type, n, size, n_exp=5):
+    comp = (
+        setup_seq_dot_computation(n)
+        if comp_type == "seq"
+        else setup_par_dot_computation(n)
+    )
+    rng = np.random.default_rng(42)
+    # keep magnitudes small so a chain of n dots stays in fixed(8, 27)
+    scale = (0.9 / size) ** 0.5
+    x = rng.uniform(0.5, 1.0, size=(size, size)) * scale
+    y = rng.uniform(0.5, 1.0, size=(size, size)) * scale
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
+    args = {"x_arg": x, "y_arg": y}
+    runtime.evaluate_computation(comp, arguments=args)  # compile
+    times = []
+    for _ in range(n_exp):
+        t0 = time.perf_counter()
+        runtime.evaluate_computation(comp, arguments=args)
+        times.append(time.perf_counter() - t0)
+    return {
+        "bench": f"{comp_type}_dot",
+        "n": n,
+        "size": size,
+        "median_s": statistics.median(times),
+        "min_s": min(times),
+        "max_s": max(times),
+    }
+
+
+# reference tables (moose column, 3x c5.9xlarge over gRPC,
+# benchmarks/README.md:19-36)
+REFERENCE_ROWS = [
+    ("seq", 1, 1000, 5.910),
+    ("seq", 100, 100, 0.675),
+    ("seq", 100, 1000, 545.675),
+    ("parallel", 100, 1000, 163.098),
+    ("parallel", 1, 1, 0.039),
+]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--c", dest="comp_type", default="parallel",
+                        choices=["seq", "parallel"])
+    parser.add_argument("--n", type=int, default=1)
+    parser.add_argument("--size", type=int, default=1000)
+    parser.add_argument("--n_exp", type=int, default=5)
+    parser.add_argument("--all", action="store_true",
+                        help="run every reference table row")
+    args = parser.parse_args()
+
+    rows = (
+        [(c, n, s, ref) for c, n, s, ref in REFERENCE_ROWS]
+        if args.all
+        else [(args.comp_type, args.n, args.size, None)]
+    )
+    for comp_type, n, size, ref in rows:
+        result = run_one(comp_type, n, size, args.n_exp)
+        if ref is not None:
+            result["reference_s"] = ref
+            result["speedup"] = ref / result["median_s"]
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

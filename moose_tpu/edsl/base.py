@@ -180,12 +180,37 @@ class Expression:
                     f"unsupported ShapeType slice spec {slice_spec!r}"
                 )
             return sliced(self, begin, end, placement=self.placement)
-        if isinstance(slice_spec, slice) or slice_spec is Ellipsis:
+        if isinstance(slice_spec, (slice, int, np.integer)) or (
+            slice_spec is Ellipsis
+        ):
             slice_spec = (slice_spec,)
         if isinstance(slice_spec, (tuple, list)) and all(
-            isinstance(s, slice) or s is Ellipsis for s in slice_spec
+            isinstance(s, (slice, int, np.integer)) or s is Ellipsis
+            for s in slice_spec
         ):
-            return strided_slice(self, slice_spec, placement=self.placement)
+            spec = list(slice_spec)
+            # integer indices: numpy semantics — select then drop the
+            # axis.  Rewrite i -> slice(i, i+1) and squeeze the axis
+            # afterwards; axes after an Ellipsis are counted from the end.
+            int_axes = []
+            ellipsis_at = next(
+                (p for p, s in enumerate(spec) if s is Ellipsis), None
+            )
+            for p, s in enumerate(spec):
+                if isinstance(s, (int, np.integer)):
+                    i = int(s)
+                    stop = i + 1 if i != -1 else None
+                    spec[p] = slice(i, stop)
+                    if ellipsis_at is not None and p > ellipsis_at:
+                        int_axes.append(p - len(spec))
+                    else:
+                        int_axes.append(p)
+            out = strided_slice(self, tuple(spec),
+                                placement=self.placement)
+            if int_axes:
+                out = squeeze(out, axis=tuple(int_axes),
+                              placement=self.placement)
+            return out
         raise ValueError(f"unsupported slice spec {slice_spec!r}")
 
     def __neg__(self):

@@ -231,13 +231,7 @@ def _classlabels(node):
 
 
 def _check_n_features(model_proto, n_coeffs):
-    model_input = model_proto.graph.input[0]
-    input_shape = predictor_utils.find_input_shape(model_input)
-    if len(input_shape) != 2:
-        raise ValueError(
-            f"expected rank-2 model input, found rank {len(input_shape)}"
-        )
-    n_features = input_shape[1].dim_value
+    n_features = predictor_utils.input_n_features(model_proto)
     if n_features != n_coeffs:
         raise ValueError(
             f"In the ONNX file, the input shape has {n_features} "

@@ -48,13 +48,7 @@ class MLPPredictor(predictor.Predictor, metaclass=abc.ABCMeta):
             for b in biases_data
         ]
 
-        model_input = model_proto.graph.input[0]
-        input_shape = predictor_utils.find_input_shape(model_input)
-        if len(input_shape) != 2:
-            raise ValueError(
-                f"expected rank-2 model input, found rank {len(input_shape)}"
-            )
-        n_features = input_shape[1].dim_value
+        n_features = predictor_utils.input_n_features(model_proto)
         if n_features != weights[0].shape[0]:
             raise ValueError(
                 f"In the ONNX file, the input shape has {n_features} "

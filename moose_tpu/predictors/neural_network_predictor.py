@@ -114,13 +114,7 @@ class NeuralNetwork(predictor.Predictor):
             weights = [w.T for w in weights[::-1]]
             biases = biases[::-1]
 
-        model_input = model_proto.graph.input[0]
-        input_shape = predictor_utils.find_input_shape(model_input)
-        if len(input_shape) != 2:
-            raise ValueError(
-                f"expected rank-2 model input, found rank {len(input_shape)}"
-            )
-        n_features = input_shape[1].dim_value
+        n_features = predictor_utils.input_n_features(model_proto)
         if n_features != weights[0].shape[0]:
             raise ValueError(
                 f"In the ONNX file, the input shape has {n_features} "
@@ -129,5 +123,11 @@ class NeuralNetwork(predictor.Predictor):
                 "correctly the `initial_types` when converting "
                 "your model to ONNX."
             )
+
+        # a final layer with no trailing activation node (e.g. a bare
+        # Gemm regressor head) contributes no entry above — pad with the
+        # identity so activations aligns with weights
+        while len(activations) < len(weights):
+            activations.append(Activation.IDENTITY)
 
         return cls(weights, biases, activations)

@@ -385,7 +385,7 @@ def load_model(source: Any) -> Any:
         return source  # a real onnx.ModelProto (or compatible)
     if hasattr(source, "read"):
         source = source.read()
-    if isinstance(source, (str, bytes)) and not isinstance(source, bytes):
+    if isinstance(source, str):
         with open(source, "rb") as f:
             source = f.read()
     if isinstance(source, (bytes, bytearray)):

@@ -87,3 +87,15 @@ def find_op_types_in_model_proto(model_proto, enforce=True):
     if enforce and not operations:
         raise ValueError("Model proto nodes do not contain op_type.")
     return operations
+
+
+def input_n_features(model_proto):
+    """Feature count from the model's rank-2 input declaration (shared
+    validation for every ONNX importer)."""
+    model_input = model_proto.graph.input[0]
+    input_shape = find_input_shape(model_input)
+    if len(input_shape) != 2:
+        raise ValueError(
+            f"expected rank-2 model input, found rank {len(input_shape)}"
+        )
+    return input_shape[1].dim_value

@@ -1,0 +1,456 @@
+"""Acceptance suite: pytest port of the reference's
+``pymoose/rust_integration_tests/*.py`` (softmax, argmax, exp, log,
+maximum, boolean ops, dtype conversions, slicing, shapes, uint64, ...)
+— the same computations and tolerance discipline against numpy, on our
+runtime, parametrized over the fused-XLA and eager execution paths."""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.runtime import LocalMooseRuntime
+
+JIT = [False, True]
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def _runtime(use_jit, storage=None):
+    return LocalMooseRuntime(
+        ["alice", "bob", "carole"],
+        storage_mapping=storage or {},
+        use_jit=use_jit,
+    )
+
+
+def _rep_unary_comp(fn_name, dtype, **kwargs):
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=bob, dtype=pm.float64)):
+        with bob:
+            xf = pm.cast(x, dtype=dtype)
+        with rep:
+            y = getattr(pm, fn_name)(xf, **kwargs)
+        with bob:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    return comp
+
+
+# -- softmax (softmax_test.py) ---------------------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+@pytest.mark.parametrize(
+    "x,axis",
+    [
+        (np.array([[[1.0, 2, 3], [4, 5, 6]], [[7, 8, 9], [10, 11, 12]]]), 0),
+        (np.array([[-1.38, 3.65, -1.56], [-1.38, 3.65, -1.8],
+                   [-0.64, 0.76, 0.97]]), 1),
+        (np.array([[-0.71, 2.3, -0.74], [0.02, -0.04, 1.08]]), 1),
+    ],
+)
+def test_replicated_softmax(x, axis, use_jit):
+    comp = _rep_unary_comp(
+        "softmax", pm.fixed(8, 27), axis=axis, upmost_index=x.shape[axis]
+    )
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"x": x}
+    ).values()
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    # reference softmax_test.py asserts decimal=2 (|err| < 1.5e-2)
+    np.testing.assert_allclose(out, e / e.sum(axis=axis, keepdims=True),
+                               atol=1.5e-2)
+
+
+# -- argmax / reduce max (argmax_test.py, reduce_max_test.py) ---------------
+
+
+@pytest.mark.parametrize(
+    "x",
+    [
+        np.array([[1.0, 7.0, 3.0], [4.0, -5.0, 6.0]]),
+        np.array([[2.5, 2.4, 9.9, 1.0]]),
+    ],
+)
+def test_replicated_argmax(x):
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=bob, dtype=pm.float64)):
+        with bob:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with rep:
+            am = pm.argmax(xf, axis=1, upmost_index=x.shape[1])
+        with bob:
+            out = pm.cast(am, dtype=pm.uint64)
+        return out
+
+    (out,) = _runtime(False).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_array_equal(out, np.argmax(x, axis=1))
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_replicated_reduce_max(use_jit):
+    x = np.array([[1.0, 7.0, 3.0], [4.0, -5.0, 6.0]])
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=bob, dtype=pm.float64)):
+        with bob:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with rep:
+            rows = [
+                pm.index_axis(xf, axis=0, index=i)
+                for i in range(x.shape[0])
+            ]
+            m = pm.maximum(rows)
+        with bob:
+            out = pm.cast(m, dtype=pm.float64)
+        return out
+
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(out, x.max(axis=0), atol=1e-6)
+
+
+# -- exp / log / log2 / sqrt / sigmoid / relu -------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+@pytest.mark.parametrize(
+    "fn,ref,x,atol",
+    [
+        ("exp", np.exp,
+         np.array([[1.0, -2.0], [0.5, -0.25]]), 1e-3),
+        ("sqrt", np.sqrt,
+         np.array([[4.0, 9.0], [0.25, 2.0]]), 1e-3),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v)),
+         np.array([[1.5, -3.0], [0.0, 4.2]]), 5e-3),
+        ("relu", lambda v: np.maximum(v, 0),
+         np.array([[1.5, -3.0], [0.0, -4.2]]), 1e-6),
+        ("log", np.log,
+         np.array([[1.0, 2.0], [0.5, 8.0]]), 1e-2),
+        ("log2", np.log2,
+         np.array([[1.0, 2.0], [0.5, 8.0]]), 1e-2),
+    ],
+)
+def test_replicated_math(fn, ref, x, atol, use_jit):
+    comp = _rep_unary_comp(fn, pm.fixed(8, 27))
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"x": x}
+    ).values()
+    np.testing.assert_allclose(out, ref(x), atol=atol)
+
+
+# -- add_n (add_n_test.py) --------------------------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+@pytest.mark.parametrize("on_rep", [False, True])
+def test_add_n(use_jit, on_rep):
+    arrays = [np.array([1.0, 2.0]), np.array([3.0, 4.0]),
+              np.array([5.5, -6.5])]
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp():
+        with bob:
+            xs = [pm.constant(a, dtype=pm.fixed(8, 27)) for a in arrays]
+        if on_rep:
+            with rep:
+                s = pm.add_n(xs)
+        else:
+            with bob:
+                s = pm.add_n(xs)
+        with bob:
+            out = pm.cast(s, dtype=pm.float64)
+        return out
+
+    (out,) = _runtime(use_jit).evaluate_computation(comp).values()
+    np.testing.assert_allclose(out, sum(arrays), atol=1e-6)
+
+
+# -- boolean ops (boolean_ops_test.py) --------------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_boolean_ops_host(use_jit):
+    a = np.array([True, False, True, False])
+    b = np.array([True, True, False, False])
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.bool_),
+        y: pm.Argument(placement=alice, dtype=pm.bool_),
+    ):
+        with alice:
+            o = pm.logical_or(x, y)
+            n = pm.logical_and(x, y)
+            z = pm.logical_xor(x, y)
+        return o, n, z
+
+    outs = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"x": a, "y": b}
+    )
+    o, n, z = outs.values()
+    np.testing.assert_array_equal(o, a | b)
+    np.testing.assert_array_equal(n, a & b)
+    np.testing.assert_array_equal(z, a ^ b)
+
+
+def test_replicated_comparisons(use_jit=False):
+    x = np.array([1.5, -2.0, 3.0, 0.0])
+    y = np.array([1.0, -2.0, 4.0, -1.0])
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        yy: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with bob:
+            yf = pm.cast(yy, dtype=pm.fixed(8, 27))
+        with rep:
+            lt = pm.less(xf, yf)
+            gt = pm.greater(xf, yf)
+        with carole:
+            lt_out = pm.cast(lt, dtype=pm.bool_)
+            gt_out = pm.cast(gt, dtype=pm.bool_)
+        return lt_out, gt_out
+
+    lt, gt = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x, "yy": y}
+    ).values()
+    np.testing.assert_array_equal(lt, x < y)
+    np.testing.assert_array_equal(gt, x > y)
+
+
+# -- concat / ones / zeros / reshape / squeeze / transpose / shape ----------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_structural_host_ops(use_jit):
+    alice, *_ = _players()
+    x = np.arange(6, dtype=np.float64).reshape(2, 3)
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            c = pm.concatenate([xx, xx], axis=0)
+            t = pm.transpose(xx)
+            r = pm.reshape(xx, [3, 2])
+            e = pm.expand_dims(xx, 0)
+            q = pm.squeeze(e)
+            o = pm.ones(pm.shape(xx), dtype=pm.float64)
+            z = pm.zeros(pm.shape(xx), dtype=pm.float64)
+        return c, t, r, q, o, z
+
+    c, t, r, q, o, z = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_array_equal(c, np.concatenate([x, x]))
+    np.testing.assert_array_equal(t, x.T)
+    np.testing.assert_array_equal(r, x.reshape(3, 2))
+    np.testing.assert_array_equal(q, x)
+    np.testing.assert_array_equal(o, np.ones_like(x))
+    np.testing.assert_array_equal(z, np.zeros_like(x))
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_replicated_concat_and_reshape(use_jit):
+    alice, bob, carole, rep = _players()
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with rep:
+            c = pm.concatenate([xf, xf], axis=1)
+            r = pm.reshape(c, [4, 2])
+        with bob:
+            out = pm.cast(r, dtype=pm.float64)
+        return out
+
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(
+        out, np.concatenate([x, x], axis=1).reshape(4, 2)
+    )
+
+
+# -- slicing (slicing_test.py) ----------------------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_slicing_host(use_jit):
+    alice, *_ = _players()
+    x = np.arange(24, dtype=np.float64).reshape(2, 3, 4)
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            a = xx[0]
+            b = xx[:, 1]
+            c = xx[..., 2]
+            d = xx[0:1, 1:3]
+        return a, b, c, d
+
+    a, b, c, d = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_array_equal(a, x[0])
+    np.testing.assert_array_equal(b, x[:, 1])
+    np.testing.assert_array_equal(c, x[..., 2])
+    np.testing.assert_array_equal(d, x[0:1, 1:3])
+
+
+# -- select (select_test.py; dynamic shape -> eager) ------------------------
+
+
+def test_select_host():
+    alice, *_ = _players()
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    keep = np.array([True, False, True, False])
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        idx: pm.Argument(placement=alice, dtype=pm.bool_),
+    ):
+        with alice:
+            y = pm.select(xx, axis=0, index=idx)
+        return y
+
+    (out,) = _runtime(False).evaluate_computation(
+        comp, arguments={"xx": x, "idx": keep}
+    ).values()
+    np.testing.assert_array_equal(out, x[keep])
+
+
+# -- mirrored ops (mirrored_ops_test.py) ------------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_mirrored_constant_ops(use_jit):
+    alice, bob, carole, rep = _players()
+    mir = pm.mirrored_placement("mir", players=[alice, bob, carole])
+    x = np.array([[2.0, -4.0], [1.0, 8.0]])
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with mir:
+            c = pm.constant(np.array([2.0]), dtype=pm.fixed(8, 27))
+        with rep:
+            y = pm.mul(xf, c)
+            z = pm.add(xf, c)
+        with bob:
+            y_out = pm.cast(y, dtype=pm.float64)
+            z_out = pm.cast(z, dtype=pm.float64)
+        return y_out, z_out
+
+    y, z = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(y, x * 2.0, atol=1e-6)
+    np.testing.assert_allclose(z, x + 2.0, atol=1e-6)
+
+
+# -- dtype conversions (dtype_conversions_test.py) --------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+@pytest.mark.parametrize(
+    "src_dtype,np_dtype",
+    [
+        (pm.float64, np.float64),
+        (pm.float32, np.float32),
+        (pm.int64, np.int64),
+        (pm.uint64, np.uint64),
+    ],
+)
+def test_dtype_cast_round_trip(src_dtype, np_dtype, use_jit):
+    alice, *_ = _players()
+    x = np.array([1, 2, 3], dtype=np_dtype)
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=src_dtype)):
+        with alice:
+            f = pm.cast(xx, dtype=pm.fixed(14, 23))
+            back = pm.cast(f, dtype=src_dtype)
+        return back
+
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float64), x.astype(np.float64)
+    )
+
+
+# -- uint64 / identity (uint64_test.py) -------------------------------------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_uint64_identity_and_save(use_jit):
+    alice, bob, carole, rep = _players()
+    x = np.array([1, 3, 2, 3], dtype=np.uint64)
+
+    @pm.computation
+    def comp():
+        with bob:
+            c = pm.constant(x)
+        with alice:
+            moved = pm.identity(c)
+            res = pm.save("x_uri", moved)
+        return res
+
+    runtime = _runtime(use_jit)
+    runtime.evaluate_computation(comp)
+    np.testing.assert_equal(
+        runtime.read_value_from_storage("alice", "x_uri"), x
+    )
+
+
+# -- rerun (rerurn_test.py): same computation evaluated repeatedly ----------
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_rerun_same_computation(use_jit):
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with rep:
+            y = pm.mul(xf, xf)
+        with bob:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    runtime = _runtime(use_jit)
+    for i in range(3):
+        x = np.array([1.0 + i, 2.0, -3.0])
+        (out,) = runtime.evaluate_computation(
+            comp, arguments={"xx": x}
+        ).values()
+        np.testing.assert_allclose(out, x * x, atol=1e-6)
