@@ -69,26 +69,49 @@ def setup_seq_dot_computation(n_seq):
     return dot_product_comp
 
 
-def run_one(comp_type, n, size, n_exp=5):
-    comp = (
-        setup_seq_dot_computation(n)
-        if comp_type == "seq"
-        else setup_par_dot_computation(n)
-    )
+def run_one(comp_type, n, size, n_exp=5, chunk=10):
+    """Time n secure dots of (size x size).
+
+    Long sequential chains are executed as n/chunk compiled chains of
+    length ``chunk``, feeding each chunk's revealed output back in as the
+    next chunk's argument — unrolling hundreds of dot+TruncPr protocols
+    into one XLA program exhausts the compiler, and chunking adds work
+    (an extra share/reveal per chunk boundary), never removes it."""
     rng = np.random.default_rng(42)
     # keep magnitudes small so a chain of n dots stays in fixed(8, 27)
     scale = (0.9 / size) ** 0.5
     x = rng.uniform(0.5, 1.0, size=(size, size)) * scale
     y = rng.uniform(0.5, 1.0, size=(size, size)) * scale
     runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
-    args = {"x_arg": x, "y_arg": y}
-    runtime.evaluate_computation(comp, arguments=args)  # compile
+
+    chunks = 1
+    if comp_type == "seq" and n > chunk:
+        # largest divisor of n not exceeding the requested chunk length,
+        # so any n works (n=25 -> 5 chunks of 5)
+        chunk = max(d for d in range(1, chunk + 1) if n % d == 0)
+        chunks = n // chunk
+        comp = setup_seq_dot_computation(chunk)
+    elif comp_type == "seq":
+        comp = setup_seq_dot_computation(n)
+    else:
+        comp = setup_par_dot_computation(n)
+
+    def run():
+        args = {"x_arg": x, "y_arg": y}
+        for _ in range(chunks):
+            (out,) = runtime.evaluate_computation(
+                comp, arguments=args
+            ).values()
+            args = {"x_arg": np.asarray(out), "y_arg": y}
+        return out
+
+    run()  # compile
     times = []
     for _ in range(n_exp):
         t0 = time.perf_counter()
-        runtime.evaluate_computation(comp, arguments=args)
+        run()
         times.append(time.perf_counter() - t0)
-    return {
+    result = {
         "bench": f"{comp_type}_dot",
         "n": n,
         "size": size,
@@ -96,6 +119,9 @@ def run_one(comp_type, n, size, n_exp=5):
         "min_s": min(times),
         "max_s": max(times),
     }
+    if chunks > 1:
+        result["chunked"] = f"{chunks}x{chunk}"
+    return result
 
 
 # reference tables (moose column, 3x c5.9xlarge over gRPC,

@@ -644,12 +644,15 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
         x = to_rep(sess, rep, args[0])
         return fx.neg(sess, rep, x)
 
-    if kind in ("Less", "Greater"):
+    if kind in ("Less", "Greater", "Equal"):
         x = to_rep(sess, rep, args[0])
         y = to_rep(sess, rep, args[1])
         if kind == "Less":
             return rep_ops.less(sess, rep, x.tensor, y.tensor)
-        return rep_ops.greater(sess, rep, x.tensor, y.tensor)
+        if kind == "Greater":
+            return rep_ops.greater(sess, rep, x.tensor, y.tensor)
+        # Equal (reference replicated/compare.rs)
+        return rep_ops.equal_bit(sess, rep, x.tensor, y.tensor)
 
     if kind in ("And", "Or", "Xor"):
         x = to_rep(sess, rep, args[0])

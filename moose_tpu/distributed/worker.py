@@ -43,6 +43,13 @@ def execute_role(
 
     from ..execution.interpreter import _lift_array, _to_user_value
 
+    # genuinely-distributed parties must not derive share masks from the
+    # non-cryptographic default PRF (ADVICE r1; the client runtime guards
+    # too, but workers execute whatever arrives)
+    from ..dialects.ring import require_strong_prf
+
+    require_strong_prf("distributed worker")
+
     t0 = time.perf_counter()
     arguments = arguments or {}
     sess = EagerSession(session_id=session_id)

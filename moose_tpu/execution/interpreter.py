@@ -156,6 +156,68 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
     return _Plan(order, static_env, dynamic_names, use_jit, core)
 
 
+class _DeviceCache:
+    """Device-resident copies of repeated argument arrays.
+
+    Host->device transfer is the dominant per-call cost on tunneled TPU
+    setups (and non-trivial everywhere); callers that evaluate the same
+    computation repeatedly usually pass the same numpy arrays, so cache
+    the upload.  Correctness against in-place mutation: entries are
+    validated by an exact content hash on every hit (~10ms for 8MB —
+    ~50x cheaper than re-uploading through a tunnel), so ``w[:] = new``
+    between evaluations re-uploads instead of serving stale data.
+    Bounded LRU (default 512MB) so long-lived processes iterating over
+    many large arrays cannot exhaust device memory."""
+
+    def __init__(self, max_bytes: int = 512 << 20):
+        from collections import OrderedDict
+
+        self._entries: "OrderedDict[int, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._max_bytes = max_bytes
+
+    @staticmethod
+    def _fingerprint(arr) -> int:
+        return hash(arr.tobytes())
+
+    def put(self, arr):
+        import jax
+
+        if not isinstance(arr, np.ndarray) or arr.nbytes < (1 << 16):
+            return arr  # small payloads: transfer cost is noise
+        key = id(arr)
+        fp = self._fingerprint(arr)
+        entry = self._entries.get(key)
+        if entry is not None:
+            _, old_fp, device_arr, _ = entry
+            if old_fp == fp:
+                self._entries.move_to_end(key)
+                return device_arr
+            self._bytes -= arr.nbytes
+            del self._entries[key]
+        import weakref
+
+        def _expire(_, k=key):
+            e = self._entries.pop(k, None)
+            if e is not None:
+                self._bytes -= e[3]
+
+        try:
+            ref = weakref.ref(arr, _expire)
+        except TypeError:  # non-weakrefable subclass
+            return arr
+        device_arr = jax.device_put(arr)
+        self._entries[key] = (ref, fp, device_arr, arr.nbytes)
+        self._bytes += arr.nbytes
+        while self._bytes > self._max_bytes and self._entries:
+            _, evicted = self._entries.popitem(last=False)
+            self._bytes -= evicted[3]
+        return device_arr
+
+
+_device_cache = _DeviceCache()
+
+
 def _lift_array(arr, op, plc_name: str):
     """Bind a host-boundary array (possibly a jit tracer) as a runtime
     value."""
@@ -218,7 +280,10 @@ class Interpreter:
             op = comp.operations[name]
             plc = comp.placement_of(op)
             if op.kind == "Input":
-                dyn[name] = np.asarray(arguments[name])
+                val = arguments[name]
+                if not isinstance(val, np.ndarray):
+                    val = np.asarray(val)
+                dyn[name] = _device_cache.put(val)
             else:  # Load
                 key = self._resolve_load_key(plan, comp, op, arguments)
                 store = storage.get(plc.name, {})
@@ -227,7 +292,10 @@ class Interpreter:
                         f"no value for key {key!r} in storage of "
                         f"{plc.name!r}"
                     )
-                dyn[name] = np.asarray(store[key])
+                val = store[key]
+                if not isinstance(val, np.ndarray):
+                    val = np.asarray(val)
+                dyn[name] = _device_cache.put(val)
 
         master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
         outputs, saves = fn(master_key, dyn)

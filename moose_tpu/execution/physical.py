@@ -341,12 +341,17 @@ class PhysicalInterpreter:
             per_comp[cache_key] = plan
         order, key_ops, dyn_names, static_env, fn = plan
 
+        from .interpreter import _device_cache
+
         dyn = {}
         for n in dyn_names:
             op = comp.operations[n]
             plc = comp.placement_of(op).name
             if op.kind == "Input":
-                dyn[n] = np.asarray(arguments[n])
+                val = arguments[n]
+                if not isinstance(val, np.ndarray):
+                    val = np.asarray(val)
+                dyn[n] = _device_cache.put(val)
             else:  # Load
                 key_op = comp.operations[op.inputs[0]]
                 key = key_op.attributes.get("value")
@@ -359,7 +364,10 @@ class PhysicalInterpreter:
                     raise StorageError(
                         f"no value for key {key!r} in storage of {plc!r}"
                     )
-                dyn[n] = np.asarray(store[key])
+                val = store[key]
+                if not isinstance(val, np.ndarray):
+                    val = np.asarray(val)
+                dyn[n] = _device_cache.put(val)
 
         keys = {n: _fresh_key_words() for n in key_ops}
         outputs, saves = fn(keys, dyn)

@@ -3,10 +3,16 @@ backends — the reference's AsyncTestRuntime-style coverage (one worker per
 identity in a single process, real Send/Recv code paths, fake or real
 wire)."""
 
+import os
 import threading
 
 import numpy as np
 import pytest
+
+# the test "cluster" lives in one process/trust domain, so the
+# non-cryptographic default PRF is acceptable here; real deployments
+# must set MOOSE_TPU_PRF=threefry (worker.execute_role enforces this)
+os.environ.setdefault("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
 
 import moose_tpu as pm
 from moose_tpu.compilation import DEFAULT_PASSES, compile_computation

@@ -454,3 +454,29 @@ def test_rerun_same_computation(use_jit):
             comp, arguments={"xx": x}
         ).values()
         np.testing.assert_allclose(out, x * x, atol=1e-6)
+
+
+def test_replicated_equal():
+    x = np.array([1.5, -2.0, 3.0, 0.0])
+    y = np.array([1.5, -2.0, 4.0, -1.0])
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        yy: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(8, 27))
+        with bob:
+            yf = pm.cast(yy, dtype=pm.fixed(8, 27))
+        with rep:
+            eq = pm.equal(xf, yf)
+        with carole:
+            out = pm.cast(eq, dtype=pm.bool_)
+        return out
+
+    (eq,) = _runtime(False).evaluate_computation(
+        comp, arguments={"xx": x, "yy": y}
+    ).values()
+    np.testing.assert_array_equal(eq, x == y)
