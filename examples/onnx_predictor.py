@@ -1,0 +1,40 @@
+"""ONNX model import + encrypted inference through the predictor zoo
+(reference pymoose/pymoose/predictors): train with sklearn, export to
+ONNX, score under 3-party replicated sharing.
+
+  python examples/onnx_predictor.py
+"""
+
+import numpy as np
+
+import moose_tpu as pm
+from moose_tpu import predictors
+from moose_tpu.predictors.sklearn_export import logistic_regression_onnx
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def main():
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(2)
+    x_train = rng.normal(size=(200, 20))
+    y_train = (rng.uniform(size=200) > 0.5).astype(int)
+    sk = LogisticRegression().fit(x_train, y_train)
+
+    onnx_bytes = logistic_regression_onnx(sk, n_features=20).encode()
+    model = predictors.from_onnx(onnx_bytes)
+    comp = model.predictor_factory()
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    x = rng.normal(size=(16, 20))
+    (probs,) = runtime.evaluate_computation(
+        comp, arguments={"x": x}
+    ).values()
+    gap = np.abs(probs - sk.predict_proba(x)).max()
+    print(f"max |secure - sklearn| probability gap: {gap:.2e}")
+    assert gap < 5e-3
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -170,11 +170,15 @@ class _DeviceCache:
     many large arrays cannot exhaust device memory."""
 
     def __init__(self, max_bytes: int = 512 << 20):
+        import threading
         from collections import OrderedDict
 
         self._entries: "OrderedDict[int, tuple]" = OrderedDict()
         self._bytes = 0
         self._max_bytes = max_bytes
+        # the cache is a process-global shared by both interpreters and
+        # by distributed worker threads
+        self._lock = threading.Lock()
 
     @staticmethod
     def _fingerprint(arr) -> int:
@@ -187,31 +191,36 @@ class _DeviceCache:
             return arr  # small payloads: transfer cost is noise
         key = id(arr)
         fp = self._fingerprint(arr)
-        entry = self._entries.get(key)
-        if entry is not None:
-            _, old_fp, device_arr, _ = entry
-            if old_fp == fp:
-                self._entries.move_to_end(key)
-                return device_arr
-            self._bytes -= arr.nbytes
-            del self._entries[key]
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                _, old_fp, device_arr, size = entry
+                if old_fp == fp:
+                    self._entries.move_to_end(key)
+                    return device_arr
+                # stale content: account with the size the entry was
+                # stored at (the array may have been resized in place)
+                self._bytes -= size
+                del self._entries[key]
         import weakref
 
         def _expire(_, k=key):
-            e = self._entries.pop(k, None)
-            if e is not None:
-                self._bytes -= e[3]
+            with self._lock:
+                e = self._entries.pop(k, None)
+                if e is not None:
+                    self._bytes -= e[3]
 
         try:
             ref = weakref.ref(arr, _expire)
         except TypeError:  # non-weakrefable subclass
             return arr
         device_arr = jax.device_put(arr)
-        self._entries[key] = (ref, fp, device_arr, arr.nbytes)
-        self._bytes += arr.nbytes
-        while self._bytes > self._max_bytes and self._entries:
-            _, evicted = self._entries.popitem(last=False)
-            self._bytes -= evicted[3]
+        with self._lock:
+            self._entries[key] = (ref, fp, device_arr, arr.nbytes)
+            self._bytes += arr.nbytes
+            while self._bytes > self._max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted[3]
         return device_arr
 
 

@@ -74,6 +74,23 @@ jax.tree_util.register_pytree_node(
 # ---------------------------------------------------------------------------
 
 
+def derive_step_keys(master_key, n: int, salt: int = 0x9E3779B9):
+    """Per-iteration session keys for protocol steps under ``lax.scan``:
+    mask freshness per step is a protocol concern, so the derivation lives
+    here rather than in each caller.  Returns uint32[n, 4]."""
+    steps = jnp.arange(n, dtype=jnp.uint32)
+    mk = jnp.asarray(master_key, dtype=jnp.uint32)
+    return mk[None, :] ^ jnp.stack(
+        [
+            steps,
+            steps * jnp.uint32(salt),
+            steps ^ jnp.uint32(0xC2B2AE35),
+            steps | jnp.uint32(1),
+        ],
+        axis=1,
+    )
+
+
 class SpmdSession:
     """Derives all per-invocation randomness from one master key.
 
