@@ -19,6 +19,7 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..computation import (
+    AES_TY_NAMES,
     Computation,
     HostPlacement,
     Operation,
@@ -83,12 +84,6 @@ def _lift_boundary(sess, op, plc_name: str, shape, np_dtype):
     """Emit a host-level boundary op (Input/Load) and wrap its result as a
     symbolic runtime value."""
     ret = op.signature.return_type
-    if ret.name in ("AesTensor", "AesKey", "HostAesKey", "ReplicatedAesKey"):
-        raise CompilationError(
-            f"op {op.name}: AES-typed inputs are not supported by the "
-            "explicit lowering pipeline yet; evaluate without "
-            "compiler_passes (the default fused path decrypts under MPC)"
-        )
     dtype = ret.dtype
     if dtype is not None and dtype.is_fixedpoint:
         raise CompilationError(
@@ -137,6 +132,13 @@ def lower(comp: Computation, arg_specs: Optional[dict] = None) -> Computation:
         kind = op.kind
 
         if kind == "Input":
+            if op.signature.return_type.name in AES_TY_NAMES:
+                raise CompilationError(
+                    f"op {name}: AES-typed inputs are not supported by "
+                    "the explicit lowering pipeline yet; evaluate without "
+                    "compiler_passes (the default fused path decrypts "
+                    "under MPC)"
+                )
             spec = arg_specs.get(name)
             if spec is None:
                 raise MissingArgumentError(
@@ -158,9 +160,7 @@ def lower(comp: Computation, arg_specs: Optional[dict] = None) -> Computation:
             continue
 
         if kind == "Load":
-            if op.signature.return_type.name in (
-                "AesTensor", "AesKey", "HostAesKey", "ReplicatedAesKey"
-            ):
+            if op.signature.return_type.name in AES_TY_NAMES:
                 raise CompilationError(
                     f"op {name}: AES-typed Loads are not supported by the "
                     "explicit lowering pipeline yet; evaluate without "

@@ -166,6 +166,11 @@ AesKeyTy = Ty("AesKey")
 ReplicatedAesKeyTy = Ty("ReplicatedAesKey")
 HostAesKeyTy = Ty("HostAesKey")
 
+# every AES-typed value name, for boundary dispatch/guards
+AES_TY_NAMES = frozenset(
+    {"AesTensor", "AesKey", "HostAesKey", "ReplicatedAesKey"}
+)
+
 
 def host_fixed_ty(dtype: dt.DType) -> Ty:
     total = 64 if dtype.name == "fixed64" else 128

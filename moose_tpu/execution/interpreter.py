@@ -124,9 +124,9 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
             if op.kind in ("Input", "Load"):
                 arr = dyn[name]
                 ret_name = op.signature.return_type.name
-                if ret_name in (
-                    "AesTensor", "AesKey", "HostAesKey", "ReplicatedAesKey"
-                ):
+                from ..computation import AES_TY_NAMES
+
+                if ret_name in AES_TY_NAMES:
                     from ..dialects import aes
 
                     env[name] = aes.lift_input(sess, comp, op, arr, plc.name)

@@ -480,3 +480,81 @@ def test_replicated_equal():
         comp, arguments={"xx": x, "yy": y}
     ).values()
     np.testing.assert_array_equal(eq, x == y)
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_replicated_division(use_jit):
+    """Goldschmidt division under MPC (reference examples/division)."""
+    x = np.array([[1.0, -4.5], [12.0, 0.75]])
+    y = np.array([[2.0, 3.0], [8.0, 0.5]])
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        yy: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with bob:
+            yf = pm.cast(yy, dtype=pm.fixed(14, 23))
+        with rep:
+            q = pm.div(xf, yf)
+        with carole:
+            out = pm.cast(q, dtype=pm.float64)
+        return out
+
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x, "yy": y}
+    ).values()
+    np.testing.assert_allclose(out, x / y, rtol=2e-3)
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_replicated_sum_mean_abs_square(use_jit):
+    x = np.array([[1.5, -2.0, 3.0], [4.0, -5.5, 6.0]])
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with rep:
+            s = pm.sum(xf, axis=0)
+            m = pm.mean(xf, axis=1)
+            a = pm.abs(xf)
+            q = pm.square(xf)
+        with bob:
+            s_out = pm.cast(s, dtype=pm.float64)
+            m_out = pm.cast(m, dtype=pm.float64)
+            a_out = pm.cast(a, dtype=pm.float64)
+            q_out = pm.cast(q, dtype=pm.float64)
+        return s_out, m_out, a_out, q_out
+
+    s, m, a, q = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(s, x.sum(axis=0), atol=1e-5)
+    np.testing.assert_allclose(m, x.mean(axis=1), atol=1e-5)
+    np.testing.assert_allclose(a, np.abs(x), atol=1e-5)
+    np.testing.assert_allclose(q, x * x, atol=1e-5)
+
+
+@pytest.mark.parametrize("use_jit", JIT)
+def test_host_inverse(use_jit):
+    """Matrix inverse on host (reference InverseOperation; LAPACK in the
+    reference, jnp.linalg.inv here)."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 4)) + 4 * np.eye(4)
+    alice, *_ = _players()
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            inv = pm.inverse(xx)
+        return inv
+
+    (out,) = _runtime(use_jit).evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(out, np.linalg.inv(x), atol=1e-8)
