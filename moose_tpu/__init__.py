@@ -119,6 +119,7 @@ def __getattr__(name):
         "predictors": ("predictors", None),
         "elk_compiler": ("elk_compiler", None),
         "parallel": ("parallel", None),
+        "telemetry": ("telemetry", None),
     }
     if name in lazy:
         import importlib

@@ -40,6 +40,21 @@ def main(argv=None):
         help="directory for .npy/.csv filesystem storage (in-memory dict "
         "if omitted)",
     )
+    parser.add_argument(
+        "--tls-cert", default=None,
+        help="PEM certificate chain for this identity (CN *and* a "
+        "subjectAltName DNS entry must equal --identity — gRPC checks "
+        "the SAN); enables mTLS (reference comet certificate flags)",
+    )
+    parser.add_argument("--tls-key", default=None,
+                        help="PEM private key for --tls-cert")
+    parser.add_argument("--tls-ca", default=None,
+                        help="PEM CA bundle that signs every party")
+    parser.add_argument(
+        "--choreographer", default=None,
+        help="only this certificate CN may launch/abort sessions "
+        "(requires the --tls-* flags)",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -54,9 +69,17 @@ def main(argv=None):
         from moose_tpu.storage import FilesystemStorage
 
         storage = FilesystemStorage(args.storage_dir)
+    from moose_tpu.distributed.tls import tls_config_from_flags
+
+    try:
+        tls = tls_config_from_flags(args.tls_cert, args.tls_key, args.tls_ca)
+    except ValueError as e:
+        parser.error(str(e))
+    if args.choreographer is not None and tls is None:
+        parser.error("--choreographer requires the --tls-* flags")
     server = WorkerServer(
         args.identity, args.port, parse_endpoints(args.endpoints),
-        storage=storage,
+        storage=storage, tls=tls, choreographer=args.choreographer,
     ).start()
     logging.getLogger("comet").info(
         "worker %s listening on port %d", args.identity, server.port

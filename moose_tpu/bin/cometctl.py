@@ -51,11 +51,22 @@ def _load_args(path):
     }
 
 
+def _tls_from_args(args):
+    from moose_tpu.distributed.tls import tls_config_from_flags
+
+    try:
+        return tls_config_from_flags(
+            args.tls_cert, args.tls_key, args.tls_ca
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+
 def cmd_run(args):
     from moose_tpu.distributed.client import GrpcClientRuntime
 
     session_id, comp, roles = _load_session(args.session)
-    runtime = GrpcClientRuntime(roles)
+    runtime = GrpcClientRuntime(roles, tls=_tls_from_args(args))
     outputs, timings = runtime.run_computation(
         comp, _load_args(args.args)
     )
@@ -76,8 +87,10 @@ def cmd_abort(args):
     from moose_tpu.distributed.choreography import ChoreographyClient
 
     session_id, _, roles = _load_session(args.session)
+    tls = _tls_from_args(args)
     for role, endpoint in roles.items():
-        ChoreographyClient(endpoint).abort(session_id)
+        ChoreographyClient(endpoint, tls=tls,
+                           expected_identity=role).abort(session_id)
         print(f"aborted {session_id} on {role}")
 
 
@@ -93,6 +106,13 @@ def main(argv=None):
     p_abort = sub.add_parser("abort", help="abort a session")
     p_abort.add_argument("session")
     p_abort.set_defaults(fn=cmd_abort)
+    for p in (p_run, p_abort):
+        p.add_argument("--tls-cert", default=None,
+                       help="PEM certificate chain (CN = client identity)")
+        p.add_argument("--tls-key", default=None,
+                       help="PEM private key for --tls-cert")
+        p.add_argument("--tls-ca", default=None,
+                       help="PEM CA bundle that signs every party")
     args = parser.parse_args(argv)
     args.fn(args)
 

@@ -35,6 +35,10 @@ def _write_computation(comp, path: str | None, fmt: str):
 
     if fmt == "textual":
         out = to_textual(comp).encode()
+    elif fmt == "dot":
+        from moose_tpu.compilation.print import to_dot
+
+        out = to_dot(comp).encode()
     else:
         out = serialize_computation(comp)
     if path is None or path == "-":
@@ -114,7 +118,7 @@ def main(argv=None):
         "by the lowering pass: XLA static shapes)",
     )
     p_compile.add_argument(
-        "--format", choices=["textual", "msgpack"], default=None
+        "--format", choices=["textual", "msgpack", "dot"], default=None
     )
     p_compile.set_defaults(fn=cmd_compile)
 

@@ -37,27 +37,42 @@ def compile_computation(
 ) -> Computation:
     """Run compiler passes over ``comp`` and return the compiled graph
     (reference compile(), compilation/mod.rs:120-132)."""
+    from .. import telemetry
+
     if passes is None:
         passes = list(DEFAULT_PASSES)
     for p in passes:
-        if p == "typing":
-            comp = typing_pass(comp)
-        elif p == "lowering":
-            comp = lower(comp, arg_specs)
-        elif p == "prune":
-            comp = prune(comp)
-        elif p == "networking":
-            comp = networking_pass(comp)
-        elif p == "toposort":
-            comp = toposort_pass(comp)
-        elif p == "wellformed":
-            well_formed_check(comp)
-        elif p == "dump":
-            from ..textual import to_textual
-
-            print(to_textual(comp))
-        elif callable(p):
-            comp = p(comp) or comp
-        else:
-            raise CompilationError(f"unknown compiler pass: {p!r}")
+        pass_name = p if isinstance(p, str) else getattr(
+            p, "__name__", "custom"
+        )
+        with telemetry.span(f"pass:{pass_name}"):
+            comp = _run_pass(comp, p, arg_specs)
     return comp
+
+
+def _run_pass(comp, p, arg_specs):
+    if p == "typing":
+        return typing_pass(comp)
+    if p == "lowering":
+        return lower(comp, arg_specs)
+    if p == "prune":
+        return prune(comp)
+    if p == "networking":
+        return networking_pass(comp)
+    if p == "toposort":
+        return toposort_pass(comp)
+    if p == "wellformed":
+        well_formed_check(comp)
+        return comp
+    if p == "dump":
+        from ..textual import to_textual
+
+        print(to_textual(comp))
+        return comp
+    if p == "dot":
+        from .print import print_pass
+
+        return print_pass(comp)
+    if callable(p):
+        return p(comp) or comp
+    raise CompilationError(f"unknown compiler pass: {p!r}")

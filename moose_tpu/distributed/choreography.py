@@ -39,12 +39,23 @@ class WorkerServer:
     background threads (reference comet, bin/comet/comet.rs:12-83)."""
 
     def __init__(self, identity: str, port: int, endpoints: dict,
-                 storage: Optional[dict] = None):
+                 storage: Optional[dict] = None, tls=None,
+                 choreographer: Optional[str] = None):
         self.identity = identity
         self.port = port
         self.endpoints = dict(endpoints)
         self.storage = storage if storage is not None else {}
-        self.networking = GrpcNetworking(identity, self.endpoints)
+        self.tls = tls  # distributed.tls.TlsConfig or None
+        # when set (requires tls), only a peer whose certificate CN equals
+        # this name may launch/abort sessions (reference
+        # choreography/grpc.rs:64-94 check_choreographer)
+        self.choreographer = choreographer
+        if choreographer is not None and tls is None:
+            raise NetworkingError(
+                "choreographer authorization requires a TlsConfig — "
+                "without mTLS there is no verified peer identity"
+            )
+        self.networking = GrpcNetworking(identity, self.endpoints, tls=tls)
         self._sessions: dict = {}
         self._results = _CellStore()
         self._lock = threading.Lock()
@@ -52,7 +63,23 @@ class WorkerServer:
 
     # -- rpc handlers ---------------------------------------------------
 
-    def _launch(self, request: bytes) -> bytes:
+    def _check_choreographer(self, context) -> None:
+        if self.choreographer is None:
+            return
+        from .tls import peer_common_name
+
+        peer = peer_common_name(context) if context is not None else None
+        if peer != self.choreographer:
+            raise NetworkingError(
+                f"unauthorized choreographer: peer CN {peer!r}, expected "
+                f"{self.choreographer!r}"
+            )
+
+    def _launch(self, request: bytes, context=None) -> bytes:
+        self._check_choreographer(context)
+        return self._launch_inner(request)
+
+    def _launch_inner(self, request: bytes) -> bytes:
         from ..serde import deserialize_computation, deserialize_value
 
         msg = _unpack(request)
@@ -96,12 +123,16 @@ class WorkerServer:
         threading.Thread(target=run, daemon=True).start()
         return _pack({"ok": True})
 
-    def _retrieve(self, request: bytes) -> bytes:
+    def _retrieve(self, request: bytes, context=None) -> bytes:
+        # results carry the computation's outputs — only the configured
+        # choreographer may read them, same as launch/abort
+        self._check_choreographer(context)
         msg = _unpack(request)
         timeout = float(msg.get("timeout", 120.0))
         return self._results.get(msg["session_id"], timeout)
 
-    def _abort(self, request: bytes) -> bytes:
+    def _abort(self, request: bytes, context=None) -> bytes:
+        self._check_choreographer(context)
         msg = _unpack(request)
         with self._lock:
             self._sessions.pop(msg["session_id"], None)
@@ -109,8 +140,8 @@ class WorkerServer:
         self._results.put(msg["session_id"], _pack({"error": "aborted"}))
         return _pack({"ok": True})
 
-    def _send_value(self, request: bytes) -> bytes:
-        return self.networking.handle_send_value(request)
+    def _send_value(self, request: bytes, context=None) -> bytes:
+        return self.networking.handle_send_value(request, context)
 
     # -- server lifecycle ----------------------------------------------
 
@@ -119,7 +150,7 @@ class WorkerServer:
 
         def unary(fn):
             return grpc.unary_unary_rpc_method_handler(
-                lambda req, ctx: fn(req),
+                lambda req, ctx: fn(req, ctx),
                 request_deserializer=None,
                 response_serializer=None,
             )
@@ -143,7 +174,12 @@ class WorkerServer:
                 ),
             )
         )
-        bound = self._server.add_insecure_port(f"[::]:{self.port}")
+        if self.tls is not None:
+            bound = self._server.add_secure_port(
+                f"[::]:{self.port}", self.tls.server_credentials()
+            )
+        else:
+            bound = self._server.add_insecure_port(f"[::]:{self.port}")
         if bound == 0:
             raise NetworkingError(f"cannot bind gRPC port {self.port}")
         self.port = bound
@@ -169,10 +205,22 @@ class ChoreographyClient:
     """Client stub for one worker (reference GrpcMooseRuntime fan-out,
     execution/grpc.rs:57-84)."""
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, tls=None,
+                 expected_identity: Optional[str] = None):
         import grpc
 
-        self._channel = grpc.insecure_channel(endpoint)
+        if tls is not None:
+            if expected_identity is None:
+                # certificates bind to party names, not addresses — an
+                # endpoint can never match a CN, so fail loudly here
+                # instead of with an opaque handshake error per-RPC
+                raise ValueError(
+                    "expected_identity is required with tls: the worker "
+                    "certificate's CN is its party name"
+                )
+            self._channel = tls.secure_channel(endpoint, expected_identity)
+        else:
+            self._channel = grpc.insecure_channel(endpoint)
 
     def launch(self, session_id: str, comp_bytes: bytes,
                arguments: dict):

@@ -16,11 +16,15 @@ from .choreography import ChoreographyClient
 
 
 class GrpcClientRuntime:
-    def __init__(self, identities: dict):
-        """``identities``: {identity/placement name: "host:port"}."""
+    def __init__(self, identities: dict, tls=None):
+        """``identities``: {identity/placement name: "host:port"};
+        ``tls``: optional :class:`moose_tpu.distributed.tls.TlsConfig` —
+        each worker must then present a certificate whose CN is its
+        identity name."""
         self.identities = dict(identities)
         self._clients = {
-            name: ChoreographyClient(endpoint)
+            name: ChoreographyClient(endpoint, tls=tls,
+                                     expected_identity=name)
             for name, endpoint in self.identities.items()
         }
 

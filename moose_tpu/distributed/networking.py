@@ -171,12 +171,13 @@ class GrpcNetworking:
     by the worker (see distributed.worker.WorkerServer)."""
 
     def __init__(self, identity: str, endpoints: dict, cells: Optional[
-            _CellStore] = None):
+            _CellStore] = None, tls=None):
         self._identity = identity
         self._endpoints = dict(endpoints)
         self.cells = cells or _CellStore()
         self._channels: dict = {}
         self._lock = threading.Lock()
+        self._tls = tls  # distributed.tls.TlsConfig or None
 
     def _stub(self, receiver: str):
         import grpc
@@ -189,15 +190,33 @@ class GrpcNetworking:
                     raise NetworkingError(
                         f"unknown receiver identity {receiver!r}"
                     )
-                ch = grpc.insecure_channel(endpoint)
+                if self._tls is not None:
+                    # the server must present a certificate for the
+                    # *receiver identity* (CN = party name)
+                    ch = self._tls.secure_channel(endpoint, receiver)
+                else:
+                    ch = grpc.insecure_channel(endpoint)
                 self._channels[receiver] = ch
             return ch.unary_unary("/moose.Networking/SendValue")
 
-    def handle_send_value(self, request: bytes) -> bytes:
-        """Server-side handler: unpack (key ‖ value) frame and post it."""
+    def handle_send_value(self, request: bytes, context=None) -> bytes:
+        """Server-side handler: unpack (key ‖ value) frame and post it.
+
+        Under mTLS the claimed sender must match the peer certificate's CN
+        (reference networking/grpc.rs:150-160 rejects spoofed senders)."""
         import msgpack
 
         frame = msgpack.unpackb(request, raw=False)
+        if self._tls is not None and context is not None:
+            from .tls import peer_common_name
+
+            peer = peer_common_name(context)
+            claimed = frame.get("sender")
+            if peer is None or peer != claimed:
+                raise NetworkingError(
+                    f"sender identity mismatch: claimed {claimed!r}, "
+                    f"peer certificate CN {peer!r}"
+                )
         self.cells.put(frame["key"], frame["value"])
         return b""
 
@@ -226,6 +245,17 @@ class GrpcNetworking:
                 self._stub(receiver)(frame, timeout=10.0)
                 return
             except Exception as e:  # grpc.RpcError
+                # identity/authorization rejections are permanent —
+                # retrying them would hide the real error behind a 60s
+                # hang per send
+                msg = str(e)
+                if (
+                    "identity mismatch" in msg
+                    or "unauthorized" in msg.lower()
+                ):
+                    raise NetworkingError(
+                        f"send to {receiver!r} rejected: {e}"
+                    ) from e
                 if time.monotonic() > deadline:
                     raise NetworkingError(
                         f"send to {receiver!r} failed: {e}"

@@ -271,6 +271,8 @@ class Interpreter:
         arguments: Optional[dict] = None,
         use_jit: bool = True,
     ) -> dict:
+        from .. import telemetry
+
         arguments = arguments or {}
         per_comp = self._cache.get(comp)
         if per_comp is None:
@@ -278,43 +280,47 @@ class Interpreter:
         cache_key = self._cache_key(arguments, use_jit)
         cached = per_comp.get(cache_key)
         if cached is None:
-            plan = build_plan(comp, arguments, use_jit)
-            fn = jax.jit(plan.core) if plan.use_jit else plan.core
+            with telemetry.span("build_plan", n_ops=len(comp.operations)):
+                plan = build_plan(comp, arguments, use_jit)
+                fn = jax.jit(plan.core) if plan.use_jit else plan.core
             per_comp[cache_key] = (plan, fn)
         else:
             plan, fn = cached
 
         dyn = {}
-        for name in plan.dynamic_names:
-            op = comp.operations[name]
-            plc = comp.placement_of(op)
-            if op.kind == "Input":
-                val = arguments[name]
-                if not isinstance(val, np.ndarray):
-                    val = np.asarray(val)
-                dyn[name] = _device_cache.put(val)
-            else:  # Load
-                key = self._resolve_load_key(plan, comp, op, arguments)
-                store = storage.get(plc.name, {})
-                if key not in store:
-                    raise KeyError(
-                        f"no value for key {key!r} in storage of "
-                        f"{plc.name!r}"
-                    )
-                val = store[key]
-                if not isinstance(val, np.ndarray):
-                    val = np.asarray(val)
-                dyn[name] = _device_cache.put(val)
+        with telemetry.span("bind_arguments"):
+            for name in plan.dynamic_names:
+                op = comp.operations[name]
+                plc = comp.placement_of(op)
+                if op.kind == "Input":
+                    val = arguments[name]
+                    if not isinstance(val, np.ndarray):
+                        val = np.asarray(val)
+                    dyn[name] = _device_cache.put(val)
+                else:  # Load
+                    key = self._resolve_load_key(plan, comp, op, arguments)
+                    store = storage.get(plc.name, {})
+                    if key not in store:
+                        raise KeyError(
+                            f"no value for key {key!r} in storage of "
+                            f"{plc.name!r}"
+                        )
+                    val = store[key]
+                    if not isinstance(val, np.ndarray):
+                        val = np.asarray(val)
+                    dyn[name] = _device_cache.put(val)
 
         master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
-        outputs, saves = fn(master_key, dyn)
-
-        for (plc_name, key), value in saves.items():
-            storage.setdefault(plc_name, {})[key] = _to_user_value(value)
-        return {
-            name: _to_user_value(outputs[name])
-            for name in ordered_output_names(outputs)
-        }
+        # the span covers output materialization as well — jit dispatch is
+        # async, so timing the call alone would under-measure
+        with telemetry.span("execute", jit=plan.use_jit):
+            outputs, saves = fn(master_key, dyn)
+            for (plc_name, key), value in saves.items():
+                storage.setdefault(plc_name, {})[key] = _to_user_value(value)
+            return {
+                name: _to_user_value(outputs[name])
+                for name in ordered_output_names(outputs)
+            }
 
     def _resolve_load_key(self, plan, comp, op, arguments) -> str:
         key_val = plan.static_env.get(op.inputs[0])

@@ -70,6 +70,8 @@ class LocalMooseRuntime:
         from .execution.physical import PhysicalInterpreter
 
         self._physical = PhysicalInterpreter()
+        # phase timings (micros) of the most recent evaluate_computation
+        self.last_timings: Dict[str, int] = {}
 
     def set_default(self):
         edsl_base.set_current_runtime(self)
@@ -80,10 +82,30 @@ class LocalMooseRuntime:
         arguments=None,
         compiler_passes=None,
     ):
+        from . import telemetry
+
+        with telemetry.span("evaluate_computation") as root:
+            result = self._evaluate_computation(
+                computation, arguments, compiler_passes
+            )
+        # coarse phase timings in micros (Local analogue of the reference's
+        # per-role elapsed-time map, pymoose/src/bindings.rs:320-328)
+        self.last_timings = telemetry.phase_timings(root)
+        return result
+
+    def _evaluate_computation(
+        self,
+        computation,
+        arguments=None,
+        compiler_passes=None,
+    ):
+        from . import telemetry
+
         if isinstance(computation, edsl_base.AbstractComputation):
             traced = self._trace_cache.get(computation)
             if traced is None:
-                traced = tracer.trace(computation)
+                with telemetry.span("trace"):
+                    traced = tracer.trace(computation)
                 self._trace_cache[computation] = traced
             computation = traced
         computation, arguments = _lift_computation(computation, arguments)
@@ -125,9 +147,10 @@ class LocalMooseRuntime:
                 )
                 compiled = per_comp.get(key)
             if compiled is None:
-                compiled = compile_computation(
-                    computation, passes=compiler_passes, arg_specs=specs
-                )
+                with telemetry.span("compile"):
+                    compiled = compile_computation(
+                        computation, passes=compiler_passes, arg_specs=specs
+                    )
                 if cacheable:
                     per_comp[key] = compiled
             return self._physical.evaluate(
@@ -157,7 +180,7 @@ class GrpcMooseRuntime:
     """Client runtime for a cluster of gRPC workers (reference
     GrpcMooseRuntime, execution/grpc.rs:11-146)."""
 
-    def __init__(self, identities: Dict):
+    def __init__(self, identities: Dict, tls=None):
         # Masks for genuinely-distributed parties must come from a real PRF
         # (ADVICE r1: the rbg default is not cryptographic).
         from .dialects.ring import require_strong_prf
@@ -179,11 +202,18 @@ class GrpcMooseRuntime:
                 "build; use LocalMooseRuntime for single-process execution"
             ) from e
 
-        self._client = GrpcClientRuntime(self.identities)
+        self._client = GrpcClientRuntime(self.identities, tls=tls)
+        # per-role elapsed micros of the most recent run (reference
+        # GrpcMooseRuntime, pymoose/src/bindings.rs:320-328)
+        self.last_timings: Dict[str, int] = {}
 
     def set_default(self):
         edsl_base.set_current_runtime(self)
 
     def evaluate_computation(self, computation, arguments=None):
         computation, arguments = _lift_computation(computation, arguments)
-        return self._client.run_computation(computation, arguments)
+        outputs, timings = self._client.run_computation(
+            computation, arguments
+        )
+        self.last_timings = dict(timings)
+        return outputs, timings

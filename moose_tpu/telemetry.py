@@ -1,0 +1,155 @@
+"""Tracing / profiling spans (reference aux subsystem: ``tracing`` crate
+spans + Jaeger export behind the ``telemetry`` feature, ``reindeer.rs:7-30``,
+and per-role elapsed-time surfaced to Python,
+``choreography/grpc.rs:26-30,186-192`` + ``pymoose/src/bindings.rs:320-328``).
+
+TPU-native re-design: the reference traces one span per async op task;
+here the whole computation is a single fused XLA program, so the
+interesting phases are *trace → compile → execute* (plus the distributed
+launch/retrieve hops).  We record a lightweight span tree per top-level
+entry point:
+
+- always-on, bounded: only the most recent completed root span tree is
+  retained (no unbounded accumulation in serving loops);
+- ``span("name")`` context manager nests via a thread-local stack, so
+  worker threads get independent trees;
+- ``last_trace()`` returns the tree, ``report()`` pretty-prints it,
+  ``to_json()`` exports it for external tooling (the Jaeger analogue —
+  zero-egress environments get a file instead of a collector);
+- ``MOOSE_TPU_TRACE=1`` additionally prints every completed root tree to
+  stderr, the moral equivalent of ``RUST_LOG=debug`` on the reference
+  binaries.
+
+Runtimes surface coarse phase timings as ``runtime.last_timings``
+(micros, like the reference's per-role map).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float
+    end_s: float = 0.0
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    children: List["Span"] = field(default_factory=list)
+
+    @property
+    def duration_s(self) -> float:
+        return self.end_s - self.start_s
+
+    @property
+    def duration_micros(self) -> int:
+        return int(self.duration_s * 1e6)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "duration_micros": self.duration_micros,
+            "attrs": self.attrs,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def find(self, name: str) -> Optional["Span"]:
+        """First span with `name` in this subtree (depth-first)."""
+        if self.name == name:
+            return self
+        for child in self.children:
+            found = child.find(name)
+            if found is not None:
+                return found
+        return None
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack: List[Span] = []
+        self.last_root: Optional[Span] = None
+
+
+_state = _State()
+
+
+def _echo_enabled() -> bool:
+    return os.environ.get("MOOSE_TPU_TRACE", "0") not in ("0", "")
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Record a timed span; nests under the enclosing span, if any."""
+    s = Span(name=name, start_s=time.perf_counter(), attrs=dict(attrs))
+    parent = _state.stack[-1] if _state.stack else None
+    _state.stack.append(s)
+    try:
+        yield s
+    finally:
+        s.end_s = time.perf_counter()
+        _state.stack.pop()
+        if parent is not None:
+            parent.children.append(s)
+        else:
+            _state.last_root = s
+            if _echo_enabled():
+                report(file=sys.stderr)
+
+
+def last_trace() -> Optional[Span]:
+    """The most recent completed root span tree on this thread."""
+    return _state.last_root
+
+
+def to_json() -> str:
+    root = _state.last_root
+    return json.dumps(root.to_dict() if root is not None else None)
+
+
+def report(file=None) -> None:
+    """Pretty-print the last completed root span tree."""
+    root = _state.last_root
+    out = file if file is not None else sys.stderr
+
+    def emit(s: Span, depth: int):
+        pad = "  " * depth
+        attrs = (
+            " " + " ".join(f"{k}={v}" for k, v in s.attrs.items())
+            if s.attrs
+            else ""
+        )
+        print(
+            f"{pad}{s.name}: {s.duration_s * 1e3:.3f} ms{attrs}", file=out
+        )
+        for child in s.children:
+            emit(child, depth + 1)
+
+    if root is None:
+        print("(no trace recorded)", file=out)
+    else:
+        emit(root, 0)
+
+
+def phase_timings(root: Optional[Span] = None) -> Dict[str, int]:
+    """Flatten a span tree into a {name: duration_micros} map — the Local
+    analogue of the reference's per-role elapsed-time map.  Durations of
+    same-named spans accumulate (e.g. a pass listed twice reports the sum
+    of both runs)."""
+    root = root if root is not None else _state.last_root
+    timings: Dict[str, int] = {}
+
+    def walk(s: Span):
+        timings[s.name] = timings.get(s.name, 0) + s.duration_micros
+        for child in s.children:
+            walk(child)
+
+    if root is not None:
+        walk(root)
+    return timings

@@ -22,6 +22,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the jit-parametrized acceptance tests
+# compile large protocol graphs (softmax/sqrt chains are ~2 min of XLA CPU
+# compile each); caching them across test runs cuts the suite from ~23 min
+# to a few minutes on a warm cache.  Override with MOOSE_TPU_COMPILE_CACHE
+# (empty string disables).
+_cache_dir = os.environ.get(
+    "MOOSE_TPU_COMPILE_CACHE",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"),
+)
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

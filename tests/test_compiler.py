@@ -250,3 +250,22 @@ def test_runtime_compiler_passes_end_to_end():
     )
     (val,) = outs.values()
     np.testing.assert_allclose(val, x * y, atol=1e-6)
+
+
+def test_dot_export_renders_graph(capsys):
+    """DOT print pass (reference compilation/print.rs): per-placement
+    clusters, one node per op, dataflow edges."""
+    from moose_tpu.compilation.print import to_dot
+
+    comp = _build_manual_graph()
+    dot = to_dot(comp)
+    assert dot.startswith("digraph computation {")
+    assert '"y" [label="y = Add"]' in dot
+    assert '"x" -> "y";' in dot
+    assert 'label="Host(alice)"' in dot
+    assert 'label="Host(bob)"' in dot
+
+    # usable as a pass: prints, leaves the graph unchanged
+    out = compile_computation(comp, passes=["dot"])
+    assert out is comp
+    assert "digraph computation {" in capsys.readouterr().out

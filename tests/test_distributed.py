@@ -22,6 +22,22 @@ from moose_tpu.distributed.worker import execute_role
 from moose_tpu.edsl import tracer
 
 
+def _cpu_subprocess_env() -> dict:
+    """Env for worker subprocesses, pinned to the CPU backend.
+
+    On single-chip dev setups several workers racing for the one
+    (tunneled) TPU serialize into receive timeouts; JAX_PLATFORMS=cpu
+    alone is not enough because the container's TPU plugin registration
+    overrides it, so the plugin trigger env var is dropped too.  The
+    8-virtual-device XLA flag the conftest exports is also stripped —
+    three workers × 8 device thread pools oversubscribes the host."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
 def _players():
     alice = pm.host_placement("alice")
     bob = pm.host_placement("bob")
@@ -275,6 +291,7 @@ def test_dasher_cli(tmp_path):
         [sys.executable, "-m", "moose_tpu.bin.dasher", str(src),
          "--args", str(args_file)],
         capture_output=True, text=True, timeout=300,
+        env=_cpu_subprocess_env(),
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "us" in out.stdout
@@ -301,14 +318,13 @@ def test_comet_cluster_multiprocess(tmp_path):
         "carole": f"127.0.0.1:{base + 2}",
     }
     ep_spec = ",".join(f"{k}={v}" for k, v in endpoints.items())
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = ""  # let each worker pick its default backend
+    env = _cpu_subprocess_env()
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "moose_tpu.bin.comet",
              "--identity", name, "--port", str(base + i),
              "--endpoints", ep_spec],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
         )
         for i, (name, _) in enumerate(endpoints.items())
     ]
@@ -350,9 +366,23 @@ def test_comet_cluster_multiprocess(tmp_path):
             [sys.executable, "-m", "moose_tpu.bin.cometctl", "run",
              str(session), "--args", str(tmp_path / "args.json"),
              "--json"],
-            capture_output=True, text=True, timeout=240,
+            capture_output=True, text=True, timeout=240, env=env,
         )
-        assert out.returncode == 0, out.stderr[-3000:]
+        if out.returncode != 0:
+            # surface worker-side logs: the client error alone (usually a
+            # receive timeout) doesn't say which worker failed or why
+            logs = []
+            for p, name in zip(procs, endpoints):
+                p.send_signal(signal.SIGTERM)
+                try:
+                    _, err = p.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    _, err = p.communicate()
+                logs.append(f"--- {name} ---\n{err.decode()[-2000:]}")
+            raise AssertionError(
+                out.stderr[-3000:] + "\n" + "\n".join(logs)
+            )
         outputs = json.loads(out.stdout.strip().splitlines()[-1])
         (got,) = (np.asarray(v) for v in outputs.values())
         assert got.shape == (2, 1)

@@ -1,0 +1,91 @@
+"""Tracing/profiling spans (reference aux subsystem: tracing crate spans,
+reindeer.rs:7-30; per-role elapsed time, pymoose/src/bindings.rs:320-328)."""
+
+import json
+
+import numpy as np
+
+import moose_tpu as pm
+from moose_tpu import telemetry
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def test_span_nesting_and_timings():
+    with telemetry.span("outer", kind="test") as outer:
+        with telemetry.span("inner"):
+            pass
+        with telemetry.span("inner2"):
+            pass
+    assert outer.name == "outer"
+    assert [c.name for c in outer.children] == ["inner", "inner2"]
+    assert outer.duration_s >= 0
+    assert telemetry.last_trace() is outer
+    assert outer.find("inner2") is not None
+
+    timings = telemetry.phase_timings()
+    assert set(timings) == {"outer", "inner", "inner2"}
+
+    blob = json.loads(telemetry.to_json())
+    assert blob["name"] == "outer"
+    assert blob["attrs"] == {"kind": "test"}
+    assert len(blob["children"]) == 2
+
+
+def test_runtime_records_phase_timings():
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))):
+        with alice:
+            y = pm.add(x, x)
+        return y
+
+    runtime = LocalMooseRuntime(["alice"])
+    x = np.ones((4,))
+    runtime.evaluate_computation(comp, arguments={"x": x})
+    t = runtime.last_timings
+    # trace/build happen on the first call; execute on every call
+    for phase in ("evaluate_computation", "trace", "build_plan", "execute"):
+        assert phase in t, f"missing phase {phase}: {t}"
+        assert t[phase] >= 0
+
+    # second call: cached trace/plan, execute still present
+    runtime.evaluate_computation(comp, arguments={"x": x})
+    t2 = runtime.last_timings
+    assert "execute" in t2
+    assert "trace" not in t2
+    assert "build_plan" not in t2
+
+
+def test_compile_path_records_pass_spans():
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))):
+        with alice:
+            y = pm.mul(x, x)
+        return y
+
+    runtime = LocalMooseRuntime(["alice"])
+    runtime.evaluate_computation(
+        comp,
+        arguments={"x": np.ones((3,))},
+        compiler_passes=["typing", "lowering", "prune", "toposort"],
+    )
+    t = runtime.last_timings
+    assert "compile" in t
+    assert "pass:lowering" in t
+    assert "pass:prune" in t
+
+
+def test_report_renders_tree(capsys):
+    with telemetry.span("root"):
+        with telemetry.span("child"):
+            pass
+    import io
+
+    buf = io.StringIO()
+    telemetry.report(file=buf)
+    text = buf.getvalue()
+    assert "root:" in text
+    assert "  child:" in text

@@ -1,0 +1,185 @@
+"""mTLS identity tests (reference networking/grpc.rs mTLS + X.509 CN
+sender verification, choreography/grpc.rs:64-94 choreographer authz,
+reindeer.rs:40-78 PEM loaders).
+
+Certificates are generated with the system openssl: one CA signs a cert
+per party with CN = party identity (plus a matching SAN, which modern
+gRPC/BoringSSL requires for name checks)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
+
+import moose_tpu as pm  # noqa: E402
+from moose_tpu.edsl import tracer  # noqa: E402
+
+
+def _openssl(*args):
+    proc = subprocess.run(
+        ["openssl", *args], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("certs")
+    ca_key, ca_pem = root / "ca.key", root / "ca.pem"
+    _openssl(
+        "req", "-x509", "-newkey", "rsa:2048", "-keyout", str(ca_key),
+        "-out", str(ca_pem), "-days", "1", "-nodes", "-subj",
+        "/CN=moose-test-ca",
+    )
+    for name in ("alice", "bob", "carole", "ctl"):
+        key, csr, pem = (
+            root / f"{name}.key", root / f"{name}.csr", root / f"{name}.pem"
+        )
+        ext = root / f"{name}.ext"
+        ext.write_text(f"subjectAltName=DNS:{name}\n")
+        _openssl(
+            "req", "-newkey", "rsa:2048", "-keyout", str(key), "-out",
+            str(csr), "-nodes", "-subj", f"/CN={name}", "-addext",
+            f"subjectAltName=DNS:{name}",
+        )
+        _openssl(
+            "x509", "-req", "-in", str(csr), "-CA", str(ca_pem), "-CAkey",
+            str(ca_key), "-CAcreateserial", "-out", str(pem), "-days", "1",
+            "-extfile", str(ext),
+        )
+    return root
+
+
+def _tls(certs, name):
+    from moose_tpu.distributed.tls import TlsConfig
+
+    return TlsConfig.from_files(
+        str(certs / f"{name}.pem"),
+        str(certs / f"{name}.key"),
+        str(certs / "ca.pem"),
+    )
+
+
+def _secure_dot_comp():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    return comp
+
+
+@pytest.fixture()
+def cluster(certs):
+    from moose_tpu.distributed.choreography import WorkerServer
+
+    identities = ["alice", "bob", "carole"]
+    servers, endpoints = {}, {}
+    try:
+        for i in identities:
+            srv = WorkerServer(
+                i, 0, {}, tls=_tls(certs, i), choreographer="ctl"
+            ).start()
+            servers[i] = srv
+            endpoints[i] = f"localhost:{srv.port}"
+        for srv in servers.values():
+            srv.endpoints.update(endpoints)
+            srv.networking._endpoints.update(endpoints)
+        yield servers, endpoints
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_mtls_cluster_end_to_end(certs, cluster):
+    """Full run under mTLS: authorized choreographer launches; workers
+    exchange shares over TLS channels bound to party identities."""
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    _, endpoints = cluster
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 3))
+    w = rng.normal(size=(3, 1))
+    runtime = GrpcClientRuntime(endpoints, tls=_tls(certs, "ctl"))
+    outputs, timings = runtime.run_computation(
+        tracer.trace(_secure_dot_comp()), {"x": x, "w": w}
+    )
+    (val,) = outputs.values()
+    np.testing.assert_allclose(val, x @ w, atol=1e-5)
+    assert set(timings) == {"alice", "bob", "carole"}
+
+
+def test_mtls_rejects_unauthorized_choreographer(certs, cluster):
+    """A peer whose CN is not the configured choreographer cannot launch
+    (choreography/grpc.rs:64-94)."""
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    _, endpoints = cluster
+    runtime = GrpcClientRuntime(endpoints, tls=_tls(certs, "alice"))
+    with pytest.raises(Exception, match="unauthorized|Unauthorized|RPC"):
+        runtime.run_computation(
+            tracer.trace(_secure_dot_comp()),
+            {"x": np.ones((2, 2)), "w": np.ones((2, 1))},
+        )
+
+    # results are choreographer-only too: a mere CA-signed party must not
+    # be able to read another session's outputs
+    import grpc
+
+    from moose_tpu.distributed.choreography import ChoreographyClient
+
+    client = ChoreographyClient(
+        endpoints["alice"], tls=_tls(certs, "bob"),
+        expected_identity="alice",
+    )
+    with pytest.raises(grpc.RpcError):
+        client.retrieve("any-session", timeout=5.0)
+
+    # tls without expected_identity cannot work (certs bind party names)
+    with pytest.raises(ValueError, match="expected_identity"):
+        ChoreographyClient(endpoints["alice"], tls=_tls(certs, "bob"))
+
+
+def test_mtls_rejects_spoofed_sender(certs, cluster):
+    """A SendValue whose claimed sender differs from the peer certificate
+    CN is rejected (networking/grpc.rs:150-160)."""
+    import grpc
+    import msgpack
+
+    _, endpoints = cluster
+    channel = _tls(certs, "alice").secure_channel(
+        endpoints["carole"], "carole"
+    )
+    stub = channel.unary_unary("/moose.Networking/SendValue")
+    frame = msgpack.packb(
+        {"key": "sess-x/rk-1", "sender": "bob", "value": b"\x00"},
+        use_bin_type=True,
+    )
+    with pytest.raises(grpc.RpcError):
+        stub(frame, timeout=5.0)
+
+
+def test_choreographer_requires_tls():
+    from moose_tpu.distributed.choreography import WorkerServer
+    from moose_tpu.errors import NetworkingError
+
+    with pytest.raises(NetworkingError, match="requires a TlsConfig"):
+        WorkerServer("alice", 0, {}, choreographer="ctl")
