@@ -66,13 +66,14 @@ class WorkerServer:
     def _check_choreographer(self, context) -> None:
         if self.choreographer is None:
             return
-        from .tls import peer_common_name
+        from .tls import peer_common_name, reject
 
         peer = peer_common_name(context) if context is not None else None
         if peer != self.choreographer:
-            raise NetworkingError(
+            reject(
+                context,
                 f"unauthorized choreographer: peer CN {peer!r}, expected "
-                f"{self.choreographer!r}"
+                f"{self.choreographer!r}",
             )
 
     def _launch(self, request: bytes, context=None) -> bytes:

@@ -207,15 +207,20 @@ class GrpcNetworking:
         import msgpack
 
         frame = msgpack.unpackb(request, raw=False)
-        if self._tls is not None and context is not None:
-            from .tls import peer_common_name
+        if self._tls is not None:
+            from .tls import peer_common_name, reject
 
-            peer = peer_common_name(context)
+            # fail closed: with mTLS configured, a missing context/peer
+            # identity is as unacceptable as a mismatched one
+            peer = (
+                peer_common_name(context) if context is not None else None
+            )
             claimed = frame.get("sender")
             if peer is None or peer != claimed:
-                raise NetworkingError(
+                reject(
+                    context,
                     f"sender identity mismatch: claimed {claimed!r}, "
-                    f"peer certificate CN {peer!r}"
+                    f"peer certificate CN {peer!r}",
                 )
         self.cells.put(frame["key"], frame["value"])
         return b""
@@ -245,13 +250,14 @@ class GrpcNetworking:
                 self._stub(receiver)(frame, timeout=10.0)
                 return
             except Exception as e:  # grpc.RpcError
-                # identity/authorization rejections are permanent —
-                # retrying them would hide the real error behind a 60s
-                # hang per send
-                msg = str(e)
+                # authorization rejections arrive as PERMISSION_DENIED
+                # (tls.reject) and are permanent — retrying would hide
+                # the real error behind a 60s hang per send
+                import grpc
+
                 if (
-                    "identity mismatch" in msg
-                    or "unauthorized" in msg.lower()
+                    isinstance(e, grpc.RpcError)
+                    and e.code() == grpc.StatusCode.PERMISSION_DENIED
                 ):
                     raise NetworkingError(
                         f"send to {receiver!r} rejected: {e}"

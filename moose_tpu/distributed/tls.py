@@ -77,14 +77,31 @@ def tls_config_from_flags(cert: Optional[str], key: Optional[str],
     """Build a TlsConfig from CLI flags: all three or none.
 
     Returns None when no flag is given; raises ValueError on a partial
-    triple (shared by the comet and cometctl CLIs)."""
+    triple or an unreadable file (shared by the comet and cometctl
+    CLIs, whose handlers turn ValueError into a one-line usage error)."""
     if not (cert or key or ca):
         return None
     if not (cert and key and ca):
         raise ValueError(
             "--tls-cert, --tls-key and --tls-ca must be given together"
         )
-    return TlsConfig.from_files(cert, key, ca)
+    try:
+        return TlsConfig.from_files(cert, key, ca)
+    except OSError as e:
+        raise ValueError(f"cannot read TLS material: {e}") from e
+
+
+def reject(context, message: str) -> None:
+    """Refuse an RPC with PERMISSION_DENIED so clients can distinguish
+    permanent authorization failures from transient transport errors
+    structurally (by status code, not message text)."""
+    if context is not None and hasattr(context, "abort"):
+        import grpc
+
+        context.abort(grpc.StatusCode.PERMISSION_DENIED, message)
+    from ..errors import NetworkingError
+
+    raise NetworkingError(message)
 
 
 def peer_common_name(context) -> Optional[str]:

@@ -62,10 +62,11 @@ class TestRing64:
         expected = (a.astype(object) @ b.astype(object)) % M64
         np.testing.assert_array_equal(np.asarray(lo).astype(object), expected)
 
-    def test_matmul_limb_f32(self):
+    @pytest.mark.parametrize("strategy", ["limb_f32", "limb_int8"])
+    def test_matmul_limb(self, strategy):
         a = rng.integers(0, M64, size=(4, 300), dtype=np.uint64)
         b = rng.integers(0, M64, size=(300, 3), dtype=np.uint64)
-        ring.set_matmul_strategy("limb_f32")
+        ring.set_matmul_strategy(strategy)
         try:
             lo, hi = ring.matmul(a, None, b, None)
         finally:
@@ -119,6 +120,26 @@ class TestRing128:
         b = np.array(ys, dtype=object).reshape(4, 2)
         lo, hi = ring.matmul(xlo, xhi, ylo, yhi)
         np.testing.assert_array_equal(as_int128(lo, hi), (a @ b) % M128)
+
+    @pytest.mark.parametrize("strategy", ["limb_f32", "limb_int8"])
+    def test_matmul128_limb_strategies(self, strategy):
+        """Every limb lowering is bit-exact against python-int ground
+        truth (full-range u128 entries, k spanning odd/one/larger)."""
+        for m, k, n in [(3, 33, 2), (2, 1, 2), (4, 300, 3)]:
+            xs = rand_u128((m, k))
+            ys = rand_u128((k, n))
+            xlo, xhi = self.to_limbs(xs, (m, k))
+            ylo, yhi = self.to_limbs(ys, (k, n))
+            a = np.array(xs, dtype=object).reshape(m, k)
+            b = np.array(ys, dtype=object).reshape(k, n)
+            ring.set_matmul_strategy(strategy)
+            try:
+                lo, hi = ring.matmul(xlo, xhi, ylo, yhi)
+            finally:
+                ring.set_matmul_strategy(None)
+            np.testing.assert_array_equal(
+                as_int128(lo, hi), (a @ b) % M128
+            )
 
     def test_sum(self):
         xs = rand_u128((7,))

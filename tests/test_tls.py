@@ -173,8 +173,10 @@ def test_mtls_rejects_spoofed_sender(certs, cluster):
         {"key": "sess-x/rk-1", "sender": "bob", "value": b"\x00"},
         use_bin_type=True,
     )
-    with pytest.raises(grpc.RpcError):
+    with pytest.raises(grpc.RpcError) as exc:
         stub(frame, timeout=5.0)
+    # structural rejection: clients classify permanence by status code
+    assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
 
 
 def test_choreographer_requires_tls():
