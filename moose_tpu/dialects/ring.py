@@ -17,6 +17,7 @@ chunks <= 256, partial sums recombine with shifts mod 2^64/2^128.
 from __future__ import annotations
 
 import functools
+import os as _os
 from typing import Optional
 
 import jax
@@ -27,9 +28,27 @@ U64 = jnp.uint64
 MASK32 = np.uint64(0xFFFFFFFF)
 
 # Matmul strategy; "native" (XLA integer dot; CPU only — TPU XLA cannot
-# rewrite u64 dot_general) or "limb_f32" (MXU bf16 limb decomposition).
-# None = auto-select by backend on first use.
+# rewrite u64 dot_general), "limb_f32" (MXU bf16 limb decomposition) or
+# "limb_int8" (centered s8 MXU path; measured equal-or-faster than
+# limb_f32 across shapes, up to 3x on large matmuls).  None = auto-select
+# by backend, with the MOOSE_TPU_MATMUL env var consulted first
+# (experiments/benchmarks); a programmatic set_matmul_strategy() wins
+# over both, and set_matmul_strategy(None) restores the env/auto default.
 _MATMUL_STRATEGY: Optional[str] = None
+
+_STRATEGIES = (None, "native", "limb_f32", "limb_int8")
+
+
+def _env_matmul_strategy() -> Optional[str]:
+    value = _os.environ.get("MOOSE_TPU_MATMUL") or None
+    if value not in _STRATEGIES:
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            "MOOSE_TPU_MATMUL must be 'native', 'limb_f32' or "
+            f"'limb_int8', got {value!r}"
+        )
+    return value
 
 
 def set_matmul_strategy(name: Optional[str]) -> None:
@@ -39,7 +58,7 @@ def set_matmul_strategy(name: Optional[str]) -> None:
     s8*s8->s32 MXU path — 2x bf16 throughput on v5e and exact s32
     accumulation up to 2^17-term contractions, so no chunking)."""
     global _MATMUL_STRATEGY
-    if name not in (None, "native", "limb_f32", "limb_int8"):
+    if name not in _STRATEGIES:
         from ..errors import ConfigurationError
 
         raise ConfigurationError(
@@ -53,9 +72,12 @@ def get_matmul_strategy() -> str:
     # Auto: the centered-int8 MXU path on TPU (measured 1.66x faster than
     # limb_f32 on the v5e secure dot and compiles ~1.5x faster), XLA's
     # native integer dot on CPU.
-    if _MATMUL_STRATEGY is None:
-        return "limb_int8" if jax.default_backend() == "tpu" else "native"
-    return _MATMUL_STRATEGY
+    if _MATMUL_STRATEGY is not None:
+        return _MATMUL_STRATEGY
+    env = _env_matmul_strategy()
+    if env is not None:
+        return env
+    return "limb_int8" if jax.default_backend() == "tpu" else "native"
 
 
 # ---------------------------------------------------------------------------
@@ -235,8 +257,6 @@ def equal_bits(lo1, hi1, lo2, hi2):
 # deterministic); the distributed runtime enforces backend homogeneity
 # otherwise.
 # ---------------------------------------------------------------------------
-
-import os as _os
 
 # Default: fast Philox ("rbg") for single-trust-domain local simulation;
 # "threefry" (a real reduced-Threefish PRF) for anything deployed across
