@@ -1,0 +1,48 @@
+"""Encrypted ResNet-style inference (north-star config from
+BASELINE.json: "ONNX MLP / small ResNet encrypted inference").
+
+A miniature residual convnet (Conv+BN+Relu+MaxPool, a residual block,
+GlobalAveragePool, Gemm head, Softmax) is imported from ONNX and
+evaluated under 3-party replicated secret sharing: the inputs are
+secret-shared, every conv runs as an exact ring convolution (im2col +
+int8-MXU limb matmul), BatchNorm folds into public mirrored affine
+constants, and only the final class probabilities are revealed.
+
+Run:  python examples/resnet_inference.py
+
+Note: the default whole-computation jit fuses the entire model into one
+XLA program; the MaxPool tournament (secure compares over ring128 bit
+decompositions) makes that graph large and slow to compile.  For quick
+runs use MOOSE_TPU_JIT=0 (eager per-op execution), or prefer
+AveragePool-only architectures for the fused path.
+"""
+
+import numpy as np
+
+import moose_tpu as pm
+from moose_tpu import predictors
+from moose_tpu.predictors.sklearn_export import resnet_block_onnx
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def main():
+    model_proto, _ = resnet_block_onnx(seed=7, in_ch=3, mid_ch=4, size=8,
+                                       n_classes=3)
+    model = predictors.from_onnx(model_proto.encode())
+    print(f"imported: {type(model).__name__}")
+
+    comp = model.predictor_factory(fixedpoint_dtype=pm.fixed(24, 40))
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 3, 8, 8)) * 0.5  # NCHW, like the ONNX export
+    (probs,) = runtime.evaluate_computation(
+        comp, arguments={"x": x}
+    ).values()
+    print("encrypted class probabilities:")
+    print(np.round(probs, 4))
+    print("rows sum to", np.round(probs.sum(axis=1), 4))
+
+
+if __name__ == "__main__":
+    main()

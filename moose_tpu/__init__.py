@@ -55,10 +55,12 @@ from .edsl.base import (  # noqa: E402
     add_n,
     argmax,
     atleast_2d,
+    avg_pool2d,
     cast,
     computation,
     concatenate,
     constant,
+    conv2d,
     decrypt,
     div,
     dot,
@@ -79,6 +81,7 @@ from .edsl.base import (  # noqa: E402
     logical_and,
     logical_or,
     logical_xor,
+    max_pool2d,
     maximum,
     mean,
     mirrored_placement,

@@ -232,6 +232,9 @@ OPERATORS = [
     "ShlDim", "TruncPr",
     # Mirrored operators
     "Demirror", "Mirror",
+    # Convolution / pooling (north-star extension — BASELINE.json configs
+    # list encrypted ResNet-style inference; no reference counterpart)
+    "Conv2D", "AvgPool2D", "MaxPool2D", "Im2Col",
 ]
 
 OPERATOR_SET = frozenset(OPERATORS)

@@ -24,7 +24,7 @@ from __future__ import annotations
 import math
 from typing import Optional, Sequence
 
-from ..values import HostFixedTensor, RepFixedTensor, RepTensor
+from ..values import HostFixedTensor, HostShape, RepFixedTensor, RepTensor
 from . import replicated as rep_ops
 
 
@@ -158,6 +158,67 @@ def sum_(sess, rep, x: RepFixedTensor, axis) -> RepFixedTensor:
         rep_ops.sum_(sess, rep, x.tensor, axis),
         x.integral_precision,
         x.fractional_precision,
+    )
+
+
+def conv2d(sess, rep, x: RepFixedTensor, k: RepFixedTensor,
+           strides=(1, 1), padding="VALID") -> RepFixedTensor:
+    """Secure fixed-point convolution: one multiplication depth, so a
+    single TruncPr after the ring conv (same scale discipline as dot)."""
+    _assert_same_precision(x, k)
+    z = rep_ops.conv2d(sess, rep, x.tensor, k.tensor, strides, padding)
+    z = rep_ops.trunc_pr(sess, rep, z, x.fractional_precision)
+    return RepFixedTensor(
+        z,
+        max(x.integral_precision, k.integral_precision),
+        x.fractional_precision,
+    )
+
+
+def avg_pool2d(sess, rep, x: RepFixedTensor, pool, strides=None,
+               padding="VALID") -> RepFixedTensor:
+    """Average pooling: share-local window sum (im2col + sum over the
+    patch axis, no interaction) then one public 1/n multiply + TruncPr."""
+    ph, pw = pool
+    strides = tuple(strides) if strides is not None else (ph, pw)
+    n, h, w, c = x.tensor.shares[0][0].shape
+    patches = rep_ops.im2col(sess, rep, x.tensor, ph, pw, strides, padding)
+    # patches: (N, OH, OW, ph*pw*C) with the window laid out as
+    # [tap0 C..., tap1 C...]; reshape to (N, OH, OW, taps, C), sum taps
+    taps = ph * pw
+    shp = patches.shares[0][0].shape
+    patches = rep_ops.reshape(
+        sess, rep, patches,
+        HostShape(shp[:3] + (taps, c), rep.owners[0]),
+    )
+    s = rep_ops.sum_(sess, rep, patches, 3)
+    factor = encode_const(
+        1.0 / taps, x.fractional_precision, _width_of(x.tensor)
+    )
+    z = mul_public_raw(sess, rep, s, factor)
+    z = rep_ops.trunc_pr(sess, rep, z, x.fractional_precision)
+    return RepFixedTensor(z, x.integral_precision, x.fractional_precision)
+
+
+def max_pool2d(sess, rep, x: RepFixedTensor, pool, strides=None,
+               padding="VALID") -> RepFixedTensor:
+    """Max pooling: tournament max over the window taps (log2(taps)
+    rounds of secure compare+mux; expensive — ResNet uses it once)."""
+    ph, pw = pool
+    strides = tuple(strides) if strides is not None else (ph, pw)
+    c = x.tensor.shares[0][0].shape[3]
+    patches = rep_ops.im2col(sess, rep, x.tensor, ph, pw, strides, padding)
+    taps = ph * pw
+    shp = patches.shares[0][0].shape
+    patches = rep_ops.reshape(
+        sess, rep, patches, HostShape(shp[:3] + (taps, c), rep.owners[0])
+    )
+    lanes = [
+        rep_ops.index_axis(sess, rep, patches, 3, i) for i in range(taps)
+    ]
+    t = maximum_ring(sess, rep, lanes)
+    return RepFixedTensor(
+        t, x.integral_precision, x.fractional_precision
     )
 
 

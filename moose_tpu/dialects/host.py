@@ -183,6 +183,25 @@ def ring_sum(x: HostRingTensor, axis, plc: str) -> HostRingTensor:
     return HostRingTensor(lo, hi, x.width, plc)
 
 
+def ring_conv2d(x: HostRingTensor, k: HostRingTensor, strides, padding,
+                plc: str) -> HostRingTensor:
+    """Exact ring convolution: NHWC input * HWIO kernel (im2col + limb
+    matmul; see ring.conv2d)."""
+    lo, hi = ring.conv2d(x.lo, x.hi, k.lo, k.hi, strides, padding)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
+def ring_im2col(x: HostRingTensor, kh: int, kw: int, strides, padding,
+                plc: str) -> HostRingTensor:
+    """Patch extraction on ring tensors (share-local data movement):
+    (N,H,W,C) -> (N,OH,OW,KH*KW*C)."""
+    lo, out_h, out_w = ring.im2col(x.lo, kh, kw, strides, padding)
+    hi = None
+    if x.hi is not None:
+        hi, _, _ = ring.im2col(x.hi, kh, kw, strides, padding)
+    return HostRingTensor(lo, hi, x.width, plc)
+
+
 def ring_shl(x: HostRingTensor, amount: int, plc: str) -> HostRingTensor:
     lo, hi = ring.shl(x.lo, x.hi, amount)
     return HostRingTensor(lo, hi, x.width, plc)
@@ -264,8 +283,8 @@ expand_dims = _structural("expand_dims")
 squeeze = _structural("squeeze")
 
 
-def transpose(x, plc: str):
-    fn = lambda a: jnp.transpose(a)
+def transpose(x, plc: str, axes=None):
+    fn = lambda a: jnp.transpose(a, axes)
     if isinstance(x, HostRingTensor):
         return _map_ring_arrays(x, fn, plc)
     if isinstance(x, HostBitTensor):
@@ -398,6 +417,53 @@ div = _f2(jnp.divide)
 
 def dot(x: HostTensor, y: HostTensor, plc: str) -> HostTensor:
     return HostTensor(jnp.matmul(x.value, y.value), plc, x.dtype)
+
+
+def conv2d(x: HostTensor, k: HostTensor, strides, padding,
+           plc: str) -> HostTensor:
+    """Plaintext conv: NHWC input * HWIO kernel (XLA native conv)."""
+    pad = padding
+    if not isinstance(pad, str):
+        pad = [tuple(p) for p in pad]
+    out = jax.lax.conv_general_dilated(
+        x.value, k.value, window_strides=tuple(strides), padding=pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return HostTensor(out, plc, x.dtype)
+
+
+def _pool2d(x: HostTensor, pool, strides, padding, plc: str,
+            init, reduce_fn, finish):
+    ph, pw = pool
+    sh, sw = strides
+    n, h, w, c = x.value.shape
+    (p0, p1), (q0, q1) = ring.resolve_padding(padding, h, w, ph, pw, sh, sw)
+    out = jax.lax.reduce_window(
+        x.value, init, reduce_fn,
+        window_dimensions=(1, ph, pw, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (p0, p1), (q0, q1), (0, 0)),
+    )
+    return HostTensor(finish(out), plc, x.dtype)
+
+
+def avg_pool2d(x: HostTensor, pool, strides, padding,
+               plc: str) -> HostTensor:
+    strides = tuple(strides) if strides is not None else tuple(pool)
+    taps = pool[0] * pool[1]
+    return _pool2d(
+        x, pool, strides, padding, plc, 0.0, jax.lax.add,
+        lambda v: v / taps,
+    )
+
+
+def max_pool2d(x: HostTensor, pool, strides, padding,
+               plc: str) -> HostTensor:
+    strides = tuple(strides) if strides is not None else tuple(pool)
+    return _pool2d(
+        x, pool, strides, padding, plc, -jnp.inf, jax.lax.max,
+        lambda v: v,
+    )
 
 
 def neg_(x: HostTensor, plc: str) -> HostTensor:

@@ -314,6 +314,51 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         }[kind]
         return fn(h, x, y)
 
+    if kind == "Conv2D":
+        x = to_host(sess, h, args[0])
+        k = to_host(sess, h, args[1])
+        strides = tuple(op.attributes.get("strides", (1, 1)))
+        padding = op.attributes.get("padding", "VALID")
+        if isinstance(x, HostFixedTensor):
+            if x.fractional_precision != k.fractional_precision:
+                from ..errors import TypeMismatchError
+
+                raise TypeMismatchError(
+                    "conv operands disagree on fractional precision: "
+                    f"{x.fractional_precision} vs {k.fractional_precision}"
+                )
+            z = sess.shr_arith(
+                h,
+                sess.conv2d(h, x.tensor, k.tensor, strides, padding),
+                x.fractional_precision,
+            )
+            return HostFixedTensor(
+                z,
+                max(x.integral_precision, k.integral_precision),
+                x.fractional_precision,
+            )
+        return sess.conv2d(h, x, k, strides, padding)
+
+    if kind in ("AvgPool2D", "MaxPool2D"):
+        from . import host as host_ops
+
+        x = to_host(sess, h, args[0])
+        pool = tuple(op.attributes["pool_size"])
+        strides = op.attributes.get("strides")
+        strides = tuple(strides) if strides is not None else None
+        padding = op.attributes.get("padding", "VALID")
+        fn = (
+            host_ops.avg_pool2d if kind == "AvgPool2D"
+            else host_ops.max_pool2d
+        )
+        if isinstance(x, HostFixedTensor):
+            # plaintext reference path: pool in float, re-encode
+            # (documented deviation, same discipline as host Div)
+            return _host_fixed_via_float(
+                sess, h, lambda v: fn(v, pool, strides, padding, h), x
+            )
+        return fn(x, pool, strides, padding, h)
+
     if kind == "AddN":
         vals = [to_host(sess, h, a) for a in args]
         out = vals[0]
@@ -536,7 +581,7 @@ def _host_structural(sess, comp, op, h, args):
     elif kind == "Squeeze":
         out = sess.squeeze(h, inner, op.attributes.get("axis"))
     elif kind == "Transpose":
-        out = sess.transpose(h, inner)
+        out = sess.transpose(h, inner, op.attributes.get("axes"))
     elif kind == "IndexAxis":
         out = sess.index_axis(
             h, inner, op.attributes["axis"], op.attributes["index"]
@@ -632,6 +677,24 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
         fn = {"Add": fx.add, "Sub": fx.sub, "Mul": fx.mul, "Dot": fx.dot,
               "Div": fx.div}[kind]
         return fn(sess, rep, xr, yr)
+
+    if kind == "Conv2D":
+        x = to_rep(sess, rep, args[0])
+        k = to_rep(sess, rep, args[1])
+        return fx.conv2d(
+            sess, rep, x, k,
+            strides=tuple(op.attributes.get("strides", (1, 1))),
+            padding=op.attributes.get("padding", "VALID"),
+        )
+
+    if kind in ("AvgPool2D", "MaxPool2D"):
+        x = to_rep(sess, rep, args[0])
+        pool = tuple(op.attributes["pool_size"])
+        strides = op.attributes.get("strides")
+        strides = tuple(strides) if strides is not None else None
+        padding = op.attributes.get("padding", "VALID")
+        fn = fx.avg_pool2d if kind == "AvgPool2D" else fx.max_pool2d
+        return fn(sess, rep, x, pool, strides, padding)
 
     if kind == "AddN":
         vals = fixed_args()
@@ -807,6 +870,8 @@ def _rep_structural(sess, comp, op, rep, x, args):
     elif kind == "IndexAxis":
         out = fn(sess, rep, inner, op.attributes["axis"],
                  op.attributes["index"])
+    elif kind == "Transpose":
+        out = fn(sess, rep, inner, axes=op.attributes.get("axes"))
     else:
         out = fn(sess, rep, inner)
     if is_fixed:

@@ -319,6 +319,29 @@ def dot(sess, rep, x: RepTensor, y: RepTensor) -> RepTensor:
     )
 
 
+def conv2d(sess, rep, x: RepTensor, k: RepTensor, strides=(1, 1),
+           padding="VALID") -> RepTensor:
+    """Secure convolution: same cross-product + zero-share-reshare
+    structure as mul/dot (replicated/arith.rs:317-454) with the local
+    contraction being a ring conv (im2col + limb matmul).  NHWC input,
+    HWIO kernel; both secret-shared."""
+    return _mul_like(
+        sess, rep, x, k,
+        lambda plc, a, b: sess.conv2d(plc, a, b, strides, padding),
+    )
+
+
+def im2col(sess, rep, x: RepTensor, kh: int, kw: int, strides=(1, 1),
+           padding="VALID") -> RepTensor:
+    """Patch extraction applied share-wise (pure local data movement —
+    sharing is linear, so patched shares reconstruct to the patched
+    secret).  Used by pooling."""
+    return _map_shares(
+        sess, rep,
+        lambda plc, a: sess.im2col(plc, a, kh, kw, strides, padding), x
+    )
+
+
 def and_bits(sess, rep, x: RepTensor, y: RepTensor) -> RepTensor:
     """AND on replicated bit shares = multiplication over Z_2."""
     p = rep.owners

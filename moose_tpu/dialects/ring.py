@@ -623,6 +623,84 @@ def _matmul_u128_int8(lo1, hi1, lo2, hi2):
 
 
 # ---------------------------------------------------------------------------
+# Convolution (north-star extension, BASELINE.json configs: encrypted
+# ResNet-style inference; no reference counterpart — the reference's model
+# zoo is Gemm-only).  Conv over the ring = dtype-agnostic im2col (pure
+# data movement, exact for any dtype incl. u64 limbs) + the exact limb
+# matmul above, so every matmul strategy applies unchanged.
+# ---------------------------------------------------------------------------
+
+
+def conv_out_size(size: int, k: int, stride: int, pad0: int, pad1: int) -> int:
+    return (size + pad0 + pad1 - k) // stride + 1
+
+
+def resolve_padding(padding, h, w, kh, kw, sh, sw):
+    """Normalize padding to ((ph0, ph1), (pw0, pw1)).
+
+    Accepts "VALID", "SAME" (TF convention: output = ceil(in/stride)),
+    or explicit ((ph0, ph1), (pw0, pw1))."""
+    if padding == "VALID":
+        return (0, 0), (0, 0)
+    if padding == "SAME":
+        def same(size, k, s):
+            out = -(-size // s)
+            total = max(0, (out - 1) * s + k - size)
+            return total // 2, total - total // 2
+
+        return same(h, kh, sh), same(w, kw, sw)
+    (p0, p1), (q0, q1) = padding
+    return (int(p0), int(p1)), (int(q0), int(q1))
+
+
+def im2col(x, kh: int, kw: int, strides, padding):
+    """Extract conv patches from an NHWC array of ANY dtype.
+
+    Returns (patches, out_h, out_w) where patches has shape
+    (N, out_h, out_w, kh*kw*C): static slices only, so it works on ring
+    limb arrays where XLA has no integer convolution."""
+    sh, sw = strides
+    n, h, w, c = x.shape
+    (ph0, ph1), (pw0, pw1) = resolve_padding(padding, h, w, kh, kw, sh, sw)
+    if ph0 or ph1 or pw0 or pw1:
+        # zero padding is exact for secret shares too: sharing is linear,
+        # so zero-padded shares reconstruct to a zero-padded secret
+        x = jnp.pad(x, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+    hp, wp = x.shape[1], x.shape[2]
+    out_h = conv_out_size(h, kh, sh, ph0, ph1)
+    out_w = conv_out_size(w, kw, sw, pw0, pw1)
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(
+                x[:, i:i + (out_h - 1) * sh + 1:sh,
+                  j:j + (out_w - 1) * sw + 1:sw, :]
+            )
+    patches = jnp.concatenate(cols, axis=-1)
+    return patches, out_h, out_w
+
+
+def conv2d(x_lo, x_hi, k_lo, k_hi, strides=(1, 1), padding="VALID"):
+    """Ring conv: x (N, H, W, C) * kernel (KH, KW, C, O) -> (N, OH, OW, O),
+    exact mod 2^64 / 2^128 via im2col + the limb matmul."""
+    kh, kw, c, o = k_lo.shape
+    p_lo, out_h, out_w = im2col(x_lo, kh, kw, strides, padding)
+    n = x_lo.shape[0]
+    cols = p_lo.reshape(n * out_h * out_w, kh * kw * c)
+    kmat_lo = k_lo.reshape(kh * kw * c, o)
+    if x_hi is None:
+        lo, hi = matmul(cols, None, kmat_lo, None)
+    else:
+        p_hi, _, _ = im2col(x_hi, kh, kw, strides, padding)
+        cols_hi = p_hi.reshape(n * out_h * out_w, kh * kw * c)
+        kmat_hi = k_hi.reshape(kh * kw * c, o)
+        lo, hi = matmul(cols, cols_hi, kmat_lo, kmat_hi)
+    lo = lo.reshape(n, out_h, out_w, o)
+    hi = hi.reshape(n, out_h, out_w, o) if hi is not None else None
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
 # Fixed-point encode/decode (reference host/fixedpoint.rs)
 # ---------------------------------------------------------------------------
 

@@ -403,6 +403,51 @@ def dot(lhs, rhs, placement=None):
     return _binary("Dot", lhs, rhs, placement, "dot")
 
 
+def conv2d(x, kernel, strides=(1, 1), padding="VALID", placement=None):
+    """2-D convolution: NHWC input, HWIO kernel.  ``padding`` is "VALID",
+    "SAME", or explicit ((top, bottom), (left, right)).  North-star
+    extension (BASELINE.json: encrypted ResNet-style inference); the
+    reference model zoo is Gemm-only."""
+    placement = _materialize_placement_arg(placement)
+    vtype = _assimilate_dtypes(x, kernel, "conv2d")
+    if not isinstance(padding, str):
+        padding = tuple(tuple(int(p) for p in side) for side in padding)
+    return _expr(
+        "Conv2D",
+        [x, kernel],
+        {"strides": tuple(int(s) for s in strides), "padding": padding},
+        placement,
+        vtype,
+    )
+
+
+def _pool2d(op, x, pool_size, strides, padding, placement):
+    placement = _materialize_placement_arg(placement)
+    if not isinstance(padding, str):
+        padding = tuple(tuple(int(p) for p in side) for side in padding)
+    attrs = {
+        "pool_size": tuple(int(p) for p in pool_size),
+        "padding": padding,
+    }
+    if strides is not None:
+        attrs["strides"] = tuple(int(s) for s in strides)
+    return _expr(op, [x], attrs, placement, x.vtype)
+
+
+def avg_pool2d(x, pool_size, strides=None, padding="VALID", placement=None):
+    """Average pooling over NHWC; strides default to the pool size.
+    Padded windows divide by the full pool size (zeros included) — the
+    equivalent of ONNX's count_include_pad=1."""
+    return _pool2d("AvgPool2D", x, pool_size, strides, padding, placement)
+
+
+def max_pool2d(x, pool_size, strides=None, padding="VALID", placement=None):
+    """Max pooling over NHWC; strides default to the pool size.  On
+    replicated placements zero padding is used, which equals the usual
+    -inf padding whenever activations are non-negative (post-ReLU)."""
+    return _pool2d("MaxPool2D", x, pool_size, strides, padding, placement)
+
+
 def div(lhs, rhs, placement=None):
     return _binary("Div", lhs, rhs, placement, "div")
 
@@ -591,8 +636,14 @@ def strided_slice(x, slices, placement=None):
     return _expr("Slice", [x], {"slices": tuple(spec)}, placement, x.vtype)
 
 
-def transpose(x, placement=None):
-    return _unary("Transpose", x, placement)
+def transpose(x, axes=None, placement=None):
+    """Transpose; ``axes=None`` reverses all axes (numpy semantics),
+    otherwise a permutation like (0, 2, 3, 1)."""
+    placement = _materialize_placement_arg(placement)
+    attrs = {}
+    if axes is not None:
+        attrs["axes"] = tuple(int(a) for a in axes)
+    return _expr("Transpose", [x], attrs, placement, x.vtype)
 
 
 def atleast_2d(x, to_column_vector=False, placement=None):

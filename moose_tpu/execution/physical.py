@@ -102,6 +102,16 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
         return sess.div(plc, args[0], args[1])
     if kind == "Dot":
         return sess.dot(plc, args[0], args[1])
+    if kind == "Conv2D":
+        return sess.conv2d(
+            plc, args[0], args[1],
+            tuple(A.get("strides", (1, 1))), A.get("padding", "VALID"),
+        )
+    if kind == "Im2Col":
+        return sess.im2col(
+            plc, args[0], A["kh"], A["kw"],
+            tuple(A.get("strides", (1, 1))), A.get("padding", "VALID"),
+        )
     if kind == "And":
         return sess.and_(plc, args[0], args[1])
     if kind == "Or":
@@ -212,7 +222,7 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
     if kind == "IndexAxis":
         return sess.index_axis(plc, args[0], A["axis"], A["index"])
     if kind == "Transpose":
-        return sess.transpose(plc, args[0])
+        return sess.transpose(plc, args[0], A.get("axes"))
     if kind == "Diag":
         return sess.diag(plc, args[0])
     if kind == "ShlDim":

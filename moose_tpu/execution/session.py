@@ -124,8 +124,8 @@ class EagerSession:
     def reshape(self, plc, x, shp):
         return host.reshape(x, shp, plc)
 
-    def transpose(self, plc, x):
-        return host.transpose(x, plc)
+    def transpose(self, plc, x, axes=None):
+        return host.transpose(x, plc, axes)
 
     def expand_dims(self, plc, x, axis):
         return host.expand_dims(x, plc, axis=axis)
@@ -187,6 +187,14 @@ class EagerSession:
         if self._is_ring(x):
             return host.ring_dot(x, y, plc)
         return host.dot(x, y, plc)
+
+    def conv2d(self, plc, x, k, strides=(1, 1), padding="VALID"):
+        if self._is_ring(x):
+            return host.ring_conv2d(x, k, strides, padding, plc)
+        return host.conv2d(x, k, strides, padding, plc)
+
+    def im2col(self, plc, x, kh, kw, strides=(1, 1), padding="VALID"):
+        return host.ring_im2col(x, kh, kw, strides, padding, plc)
 
     def neg(self, plc, x):
         if self._is_ring(x):

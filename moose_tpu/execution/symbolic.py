@@ -420,9 +420,15 @@ class SymbolicSession:
         op = self._emit("Reshape", [x, shp], plc, _ty_of(x))
         return self._like(op, tuple(shp.value), x)
 
-    def transpose(self, plc, x):
-        op = self._emit("Transpose", [x], plc, _ty_of(x))
-        return self._like(op, tuple(reversed(self._shape_of_leaf(x))), x)
+    def transpose(self, plc, x, axes=None):
+        attrs = {"axes": tuple(axes)} if axes is not None else None
+        op = self._emit("Transpose", [x], plc, _ty_of(x), attrs)
+        shape = self._shape_of_leaf(x)
+        if axes is None:
+            shape = tuple(reversed(shape))
+        else:
+            shape = tuple(shape[a] for a in axes)
+        return self._like(op, shape, x)
 
     def expand_dims(self, plc, x, axis):
         op = self._emit("ExpandDims", [x], plc, _ty_of(x), {"axis": axis})
@@ -519,6 +525,39 @@ class SymbolicSession:
         op = self._emit("Dot", [x, y], plc, _ty_of(x))
         shape = _dot_shape(self._shape_of_leaf(x), self._shape_of_leaf(y))
         return self._like(op, shape, x)
+
+    def _conv_spatial(self, x, kh, kw, strides, padding):
+        from ..dialects import ring
+
+        n, h, w, _ = self._shape_of_leaf(x)
+        sh, sw = strides
+        (p0, p1), (q0, q1) = ring.resolve_padding(
+            padding, h, w, kh, kw, sh, sw
+        )
+        return (
+            n,
+            ring.conv_out_size(h, kh, sh, p0, p1),
+            ring.conv_out_size(w, kw, sw, q0, q1),
+        )
+
+    def conv2d(self, plc, x, k, strides=(1, 1), padding="VALID"):
+        op = self._emit(
+            "Conv2D", [x, k], plc, _ty_of(x),
+            {"strides": tuple(strides), "padding": padding},
+        )
+        kh, kw, _, o = self._shape_of_leaf(k)
+        n, oh, ow = self._conv_spatial(x, kh, kw, strides, padding)
+        return self._like(op, (n, oh, ow, o), x)
+
+    def im2col(self, plc, x, kh, kw, strides=(1, 1), padding="VALID"):
+        op = self._emit(
+            "Im2Col", [x], plc, _ty_of(x),
+            {"kh": kh, "kw": kw, "strides": tuple(strides),
+             "padding": padding},
+        )
+        c = self._shape_of_leaf(x)[3]
+        n, oh, ow = self._conv_spatial(x, kh, kw, strides, padding)
+        return self._like(op, (n, oh, ow, kh * kw * c), x)
 
     def neg(self, plc, x):
         op = self._emit("Neg", [x], plc, _ty_of(x))

@@ -1,6 +1,7 @@
 """Predictor zoo: encrypted inference over imported models (reference:
 ``pymoose/pymoose/predictors/__init__.py``)."""
 
+from . import convnet_predictor
 from . import linear_predictor
 from . import multilayer_perceptron_predictor
 from . import neural_network_predictor
@@ -8,6 +9,7 @@ from . import onnx_proto
 from . import predictor
 from . import predictor_utils
 from . import tree_ensemble
+from .convnet_predictor import ConvNet
 from .linear_predictor import LinearClassifier, LinearRegressor
 from .multilayer_perceptron_predictor import MLPClassifier, MLPRegressor
 from .neural_network_predictor import NeuralNetwork
@@ -21,6 +23,7 @@ from .tree_ensemble import (
 
 __all__ = [
     "AesWrapper",
+    "ConvNet",
     "DecisionTreeRegressor",
     "LinearClassifier",
     "LinearRegressor",

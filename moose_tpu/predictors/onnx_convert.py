@@ -37,6 +37,14 @@ def from_onnx(model_proto):
     """
     model_proto = onnx_proto.load_model(model_proto)
 
+    graph_op_types = {node.op_type for node in model_proto.graph.node}
+    if "Conv" in graph_op_types:
+        # convolutional export (ResNet-style; north-star extension — the
+        # reference zoo is Gemm-only)
+        from . import convnet_predictor
+
+        return convnet_predictor.ConvNet.from_onnx(model_proto)
+
     if model_proto.producer_name in ("pytorch", "tf2onnx"):
         model_type = "NeuralNetwork"
         classes = None

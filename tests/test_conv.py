@@ -1,0 +1,302 @@
+"""Secure convolution / pooling (north-star extension — BASELINE.json
+configs list encrypted ResNet-style inference; the reference model zoo is
+Gemm-only, so there is no reference counterpart.  Protocol structure
+matches mul/dot: local ring conv cross-products + zero-share reshare +
+one TruncPr)."""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def _ref_conv(x, k, strides, padding):
+    import jax
+
+    return np.asarray(
+        jax.lax.conv_general_dilated(
+            x, k, window_strides=strides, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    )
+
+
+@pytest.mark.parametrize(
+    "strides,padding", [((1, 1), "VALID"), ((2, 2), "SAME")]
+)
+@pytest.mark.parametrize("use_jit", [False, True])
+def test_replicated_conv2d(strides, padding, use_jit):
+    alice, bob, carole, rep = _players()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 8, 8, 3)) * 0.5
+    k = rng.normal(size=(3, 3, 3, 4)) * 0.5
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        kk: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with bob:
+            kf = pm.cast(kk, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.conv2d(xf, kf, strides=strides, padding=padding)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    runtime = LocalMooseRuntime(
+        ["alice", "bob", "carole"], use_jit=use_jit
+    )
+    (got,) = runtime.evaluate_computation(
+        comp, arguments={"xx": x, "kk": k}
+    ).values()
+    want = _ref_conv(x, k, strides, padding)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_host_conv2d_float():
+    alice, *_ = _players()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 6, 6, 2))
+    k = rng.normal(size=(2, 2, 2, 3))
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        kk: pm.Argument(placement=alice, dtype=pm.float64),
+    ):
+        with alice:
+            y = pm.conv2d(xx, kk, strides=(2, 2), padding="VALID")
+        return y
+
+    runtime = LocalMooseRuntime(["alice"])
+    (got,) = runtime.evaluate_computation(
+        comp, arguments={"xx": x, "kk": k}
+    ).values()
+    np.testing.assert_allclose(
+        got, _ref_conv(x, k, (2, 2), "VALID"), atol=1e-10
+    )
+
+
+@pytest.mark.parametrize("use_jit", [False, True])
+def test_replicated_avg_pool(use_jit):
+    alice, bob, carole, rep = _players()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 6, 6, 3))
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.avg_pool2d(xf, (2, 2))
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    runtime = LocalMooseRuntime(
+        ["alice", "bob", "carole"], use_jit=use_jit
+    )
+    (got,) = runtime.evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    want = x.reshape(2, 3, 2, 3, 2, 3).mean(axis=(2, 4))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_replicated_max_pool():
+    alice, bob, carole, rep = _players()
+    rng = np.random.default_rng(3)
+    # non-negative activations (the post-ReLU regime where zero padding
+    # is equivalent to -inf padding)
+    x = np.abs(rng.normal(size=(1, 4, 4, 2)))
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.max_pool2d(xf, (2, 2))
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got,) = runtime.evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    want = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(2, 4))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_host_pooling_float():
+    alice, *_ = _players()
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(1, 4, 4, 2))
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            a = pm.avg_pool2d(xx, (2, 2))
+            m = pm.max_pool2d(xx, (2, 2))
+        return a, m
+
+    runtime = LocalMooseRuntime(["alice"])
+    a, m = runtime.evaluate_computation(
+        comp, arguments={"xx": x}
+    ).values()
+    np.testing.assert_allclose(
+        a, x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(2, 4)), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        m, x.reshape(1, 2, 2, 2, 2, 2).max(axis=(2, 4)), atol=1e-10
+    )
+
+
+def test_compiled_conv_matches_eager():
+    """Conv2D survives the full compiler pipeline (lowering via the
+    SymbolicSession records host-level ring conv ops)."""
+    alice, bob, carole, rep = _players()
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(1, 5, 5, 2)) * 0.4
+    k = rng.normal(size=(3, 3, 2, 2)) * 0.4
+
+    @pm.computation
+    def comp(
+        xx: pm.Argument(placement=alice, dtype=pm.float64),
+        kk: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with bob:
+            kf = pm.cast(kk, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.conv2d(xf, kf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    args = {"xx": x, "kk": k}
+    (eager,) = runtime.evaluate_computation(comp, arguments=args).values()
+    (compiled,) = runtime.evaluate_computation(
+        comp, arguments=args,
+        compiler_passes=["typing", "lowering", "prune", "networking",
+                         "toposort"],
+    ).values()
+    want = _ref_conv(x, k, (1, 1), "VALID")
+    np.testing.assert_allclose(eager, want, atol=1e-4)
+    np.testing.assert_allclose(compiled, want, atol=1e-4)
+
+
+def test_convnet_predictor_resnet_block():
+    """End-to-end encrypted ResNet-style inference through the real user
+    path: ONNX import -> ConvNet predictor -> LocalMooseRuntime, compared
+    against a float reference with the same weights."""
+    import jax
+
+    from moose_tpu import predictors
+    from moose_tpu.predictors.sklearn_export import resnet_block_onnx
+
+    model_proto, p = resnet_block_onnx(seed=7)
+    model = predictors.from_onnx(model_proto.encode())
+    assert isinstance(model, predictors.ConvNet)
+
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(2, 3, 8, 8)) * 0.5
+
+    comp = model.predictor_factory(fixedpoint_dtype=pm.fixed(24, 40))
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got,) = runtime.evaluate_computation(
+        comp, arguments={"x": x}
+    ).values()
+
+    # float reference (NCHW, same params, float32 weights as serialized)
+    def conv(v, w):
+        return np.asarray(jax.lax.conv_general_dilated(
+            v, w.astype(np.float64), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        ))
+
+    def bn(v, g, b, m, var):
+        g, b, m, var = (
+            np.float32(a).astype(np.float64).reshape(1, -1, 1, 1)
+            for a in (g, b, m, var)
+        )
+        return g * (v - m) / np.sqrt(var + 1e-5) + b
+
+    f32 = lambda a: np.asarray(a, dtype=np.float32).astype(np.float64)
+    h = np.maximum(bn(conv(x, f32(p["w0"])), p["g0"], p["b0"], p["m0"],
+                      p["v0"]), 0)
+    h = h.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))  # maxpool 2x2
+    r = np.maximum(bn(conv(h, f32(p["w1"])), p["g1"], p["b1"], p["m1"],
+                      p["v1"]), 0)
+    r = bn(conv(r, f32(p["w2"])), p["g2"], p["b2"], p["m2"], p["v2"])
+    h = np.maximum(r + h, 0)
+    gap = h.mean(axis=(2, 3))
+    logits = gap @ f32(p["wf"]).T + f32(p["bf"])
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=5e-3)
+
+
+def test_conv_ops_serde_roundtrip():
+    """Conv/pool attrs survive textual and msgpack serialization."""
+    from moose_tpu.edsl import tracer
+    from moose_tpu.serde import deserialize_computation, serialize_computation
+    from moose_tpu.textual import parse_computation, to_textual
+
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(xx, dtype=pm.fixed(14, 23))
+        with rep:
+            k = pm.cast(
+                pm.constant(np.ones((2, 2, 1, 1)), dtype=pm.float64),
+                dtype=pm.fixed(14, 23),
+            )
+            y = pm.conv2d(xf, k, strides=(2, 1), padding=((1, 0), (0, 1)))
+            y = pm.avg_pool2d(y, (2, 2), strides=(1, 1))
+            y = pm.transpose(y, axes=(0, 3, 1, 2))
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    traced = tracer.trace(comp)
+    for roundtrip in (
+        lambda c: parse_computation(to_textual(c)),
+        lambda c: deserialize_computation(serialize_computation(c)),
+    ):
+        back = roundtrip(traced)
+        conv_op = next(
+            o for o in back.operations.values() if o.kind == "Conv2D"
+        )
+        assert tuple(conv_op.attributes["strides"]) == (2, 1)
+        assert tuple(map(tuple, conv_op.attributes["padding"])) == (
+            (1, 0), (0, 1),
+        )
+        pool_op = next(
+            o for o in back.operations.values() if o.kind == "AvgPool2D"
+        )
+        assert tuple(pool_op.attributes["pool_size"]) == (2, 2)
+        tr_op = next(
+            o for o in back.operations.values()
+            if o.kind == "Transpose" and o.attributes
+        )
+        assert tuple(tr_op.attributes["axes"]) == (0, 3, 1, 2)
