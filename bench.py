@@ -10,6 +10,7 @@ from_onnx -> LocalMooseRuntime, jitted) rides along as extra fields.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -17,12 +18,46 @@ import numpy as np
 import moose_tpu  # noqa: F401  (enables x64)
 import jax
 
+# persistent compile cache: repeated bench runs (and the driver's) skip
+# recompiles where the backend supports caching
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 from moose_tpu.parallel import spmd
 
 BASELINE_S = 5.910  # reference: 1 sequential dot, 1000x1000, ring128
 
+# Extras (batch-1024 predictor benches) are skipped once this much wall
+# clock has elapsed, so the headline JSON line always prints well within
+# the driver's patience even on a cold compile cache.
+BUDGET_S = float(os.environ.get("MOOSE_TPU_BENCH_BUDGET_S", "900"))
+_T_START = time.monotonic()
+
+
+def _within_budget() -> bool:
+    return time.monotonic() - _T_START < BUDGET_S
+
 I, F, W = 14, 23, 128
 N = 1000
+
+
+def _bench_predictor(comp, args, check, batch):
+    """Median steady-state latency/throughput of one predictor comp."""
+    from moose_tpu.runtime import LocalMooseRuntime
+
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
+    (out,) = runtime.evaluate_computation(comp, arguments=args).values()
+    check(out)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        runtime.evaluate_computation(comp, arguments=args)
+        times.append(time.perf_counter() - t0)
+    latency = float(np.median(times))
+    return batch / latency, latency
 
 
 def bench_logreg_inference(batch=128, features=100):
@@ -31,7 +66,6 @@ def bench_logreg_inference(batch=128, features=100):
     from sklearn.linear_model import LogisticRegression
 
     from moose_tpu import predictors
-    from moose_tpu.runtime import LocalMooseRuntime
     from moose_tpu.predictors.sklearn_export import logistic_regression_onnx
 
     rng = np.random.default_rng(7)
@@ -42,21 +76,40 @@ def bench_logreg_inference(batch=128, features=100):
         logistic_regression_onnx(sk, features).encode()
     )
     comp = model.predictor_factory()
-    runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
-
     x = rng.normal(size=(batch, features))
-    args = {"x": x}
-    (out,) = runtime.evaluate_computation(comp, arguments=args).values()
-    err = np.abs(out - sk.predict_proba(x)).max()
-    assert err < 5e-3, f"logreg mismatch: {err}"
 
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        runtime.evaluate_computation(comp, arguments=args)
-        times.append(time.perf_counter() - t0)
-    latency = float(np.median(times))
-    return batch / latency, latency
+    def check(out):
+        err = np.abs(out - sk.predict_proba(x)).max()
+        assert err < 5e-3, f"logreg mismatch: {err}"
+
+    return _bench_predictor(comp, {"x": x}, check, batch)
+
+
+def bench_mlp_inference(batch=1024, features=100):
+    """Encrypted MLP inference at batch 1024 (BASELINE.json configs:
+    'ONNX MLP ... encrypted inference, batch=1024')."""
+    from sklearn.neural_network import MLPClassifier
+
+    from moose_tpu import predictors
+    from moose_tpu.predictors.sklearn_export import mlp_onnx
+
+    rng = np.random.default_rng(11)
+    x_train = rng.normal(size=(512, features))
+    y_train = (rng.uniform(size=512) > 0.5).astype(int)
+    sk = MLPClassifier(
+        hidden_layer_sizes=(64, 32), activation="relu", max_iter=40
+    ).fit(x_train, y_train)
+    model = predictors.from_onnx(
+        mlp_onnx(sk, features, classifier=True).encode()
+    )
+    comp = model.predictor_factory()
+    x = rng.normal(size=(batch, features))
+
+    def check(out):
+        err = np.abs(out - sk.predict_proba(x)).max()
+        assert err < 2e-2, f"mlp mismatch: {err}"
+
+    return _bench_predictor(comp, {"x": x}, check, batch)
 
 
 def main():
@@ -112,6 +165,18 @@ def main():
     except Exception as e:  # the headline metric must still print
         infer_per_sec, infer_latency = None, None
         print(f"# logreg inference bench failed: {e}")
+    logreg_1024_per_sec = mlp_1024_per_sec = None
+    try:
+        if _within_budget():
+            logreg_1024_per_sec, _ = bench_logreg_inference(batch=1024)
+    except Exception as e:
+        print(f"# logreg batch-1024 bench failed: {e}")
+    try:
+        if _within_budget():
+            mlp_1024_per_sec, _ = bench_mlp_inference(batch=1024)
+    except Exception as e:
+        mlp_1024_per_sec = None
+        print(f"# mlp batch-1024 bench failed: {e}")
 
     print(
         json.dumps(
@@ -132,6 +197,9 @@ def main():
                 # LocalMooseRuntime
                 "logreg_infer_per_sec": infer_per_sec,
                 "logreg_infer_batch128_latency_s": infer_latency,
+                # BASELINE.json configs: batch-1024 encrypted inference
+                "logreg_infer_batch1024_per_sec": logreg_1024_per_sec,
+                "mlp_infer_batch1024_per_sec": mlp_1024_per_sec,
             }
         )
     )

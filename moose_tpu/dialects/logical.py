@@ -340,24 +340,21 @@ def _execute_host(sess, comp, op, plc: HostPlacement, args):
         return sess.conv2d(h, x, k, strides, padding)
 
     if kind in ("AvgPool2D", "MaxPool2D"):
-        from . import host as host_ops
-
         x = to_host(sess, h, args[0])
         pool = tuple(op.attributes["pool_size"])
         strides = op.attributes.get("strides")
         strides = tuple(strides) if strides is not None else None
         padding = op.attributes.get("padding", "VALID")
-        fn = (
-            host_ops.avg_pool2d if kind == "AvgPool2D"
-            else host_ops.max_pool2d
+        method = (
+            sess.avg_pool2d if kind == "AvgPool2D" else sess.max_pool2d
         )
         if isinstance(x, HostFixedTensor):
             # plaintext reference path: pool in float, re-encode
             # (documented deviation, same discipline as host Div)
             return _host_fixed_via_float(
-                sess, h, lambda v: fn(v, pool, strides, padding, h), x
+                sess, h, lambda v: method(h, v, pool, strides, padding), x
             )
-        return fn(x, pool, strides, padding, h)
+        return method(h, x, pool, strides, padding)
 
     if kind == "AddN":
         vals = [to_host(sess, h, a) for a in args]

@@ -112,6 +112,16 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
             plc, args[0], A["kh"], A["kw"],
             tuple(A.get("strides", (1, 1))), A.get("padding", "VALID"),
         )
+    if kind in ("AvgPool2D", "MaxPool2D"):
+        method = (
+            sess.avg_pool2d if kind == "AvgPool2D" else sess.max_pool2d
+        )
+        strides = A.get("strides")
+        return method(
+            plc, args[0], tuple(A["pool_size"]),
+            tuple(strides) if strides is not None else None,
+            A.get("padding", "VALID"),
+        )
     if kind == "And":
         return sess.and_(plc, args[0], args[1])
     if kind == "Or":

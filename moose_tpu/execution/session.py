@@ -196,6 +196,12 @@ class EagerSession:
     def im2col(self, plc, x, kh, kw, strides=(1, 1), padding="VALID"):
         return host.ring_im2col(x, kh, kw, strides, padding, plc)
 
+    def avg_pool2d(self, plc, x, pool, strides=None, padding="VALID"):
+        return host.avg_pool2d(x, pool, strides, padding, plc)
+
+    def max_pool2d(self, plc, x, pool, strides=None, padding="VALID"):
+        return host.max_pool2d(x, pool, strides, padding, plc)
+
     def neg(self, plc, x):
         if self._is_ring(x):
             return host.ring_neg(x, plc)

@@ -559,6 +559,25 @@ class SymbolicSession:
         n, oh, ow = self._conv_spatial(x, kh, kw, strides, padding)
         return self._like(op, (n, oh, ow, kh * kw * c), x)
 
+    def _pool2d(self, kind, plc, x, pool, strides, padding):
+        strides = tuple(strides) if strides is not None else tuple(pool)
+        attrs = {
+            "pool_size": tuple(pool), "strides": strides,
+            "padding": padding,
+        }
+        op = self._emit(kind, [x], plc, _ty_of(x), attrs)
+        c = self._shape_of_leaf(x)[3]
+        n, oh, ow = self._conv_spatial(
+            x, pool[0], pool[1], strides, padding
+        )
+        return self._like(op, (n, oh, ow, c), x)
+
+    def avg_pool2d(self, plc, x, pool, strides=None, padding="VALID"):
+        return self._pool2d("AvgPool2D", plc, x, pool, strides, padding)
+
+    def max_pool2d(self, plc, x, pool, strides=None, padding="VALID"):
+        return self._pool2d("MaxPool2D", plc, x, pool, strides, padding)
+
     def neg(self, plc, x):
         op = self._emit("Neg", [x], plc, _ty_of(x))
         return self._like(op, self._shape_of_leaf(x), x)

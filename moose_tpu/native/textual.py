@@ -1,0 +1,86 @@
+"""ctypes loader for the C++ parallel textual parser
+(textual_parser.cpp).  Compiles the shared library on first use with the
+system g++ and caches it next to the source; returns the per-line record
+list decoded from msgpack (C-speed on both sides)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "textual_parser.cpp"
+_BUILD = _HERE / "build"
+_SO = _BUILD / "libmoose_textual.so"
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def build(force: bool = False) -> Path:
+    with _lock:
+        if _SO.exists() and not force:
+            if _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+                return _SO
+        _BUILD.mkdir(exist_ok=True)
+        tmp = _SO.with_suffix(f".so.tmp{os.getpid()}")
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            str(_SRC), "-o", str(tmp),
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"failed to build native textual parser:\n{proc.stderr}"
+            )
+        os.replace(tmp, _SO)
+        return _SO
+
+
+def load():
+    """The loaded library, or None when the toolchain is unavailable
+    (callers fall back to the pure-Python parser)."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    try:
+        path = build()
+        lib = ctypes.CDLL(str(path))
+    except (RuntimeError, OSError):
+        _build_failed = True
+        return None
+    lib.mt_parse_textual.restype = ctypes.POINTER(ctypes.c_char)
+    lib.mt_parse_textual.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.mt_parse_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    _lib = lib
+    return lib
+
+
+def parse_lines(text: str, threads: int = 0):
+    """Parse the textual format into per-line records (see
+    textual_parser.cpp for the record schema); None if unavailable."""
+    import msgpack
+
+    lib = load()
+    if lib is None:
+        return None
+    raw = text.encode()
+    out_len = ctypes.c_uint64()
+    buf = lib.mt_parse_textual(raw, len(raw), threads,
+                               ctypes.byref(out_len))
+    if not buf:
+        return None
+    try:
+        data = ctypes.string_at(buf, out_len.value)
+    finally:
+        lib.mt_parse_free(buf)
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)

@@ -79,6 +79,10 @@ class ConvNet(predictor.Predictor):
         c, h, w = self.input_shape
         env = {self.input_name: pm.transpose(x, axes=(0, 2, 3, 1))}
         shapes = {self.input_name: (-1, h, w, c)}  # batch symbolic
+        # names whose values are provably non-negative (ReLU/Sigmoid
+        # outputs and pools thereof) — required for padded MaxPool, whose
+        # zero padding only equals ONNX's -inf padding in that regime
+        nonneg: set = set()
         init = self.initializers
 
         for node in self.nodes:
@@ -95,15 +99,21 @@ class ConvNet(predictor.Predictor):
                 )
             elif op == "Relu":
                 val, shp = pm.relu(env[ins[0]]), shapes[ins[0]]
+                nonneg.add(out)
             elif op == "Sigmoid":
                 val, shp = pm.sigmoid(env[ins[0]]), shapes[ins[0]]
+                nonneg.add(out)
             elif op == "Softmax":
                 shp = shapes[ins[0]]
                 val = pm.softmax(
                     env[ins[0]], axis=1, upmost_index=shp[1]
                 )
             elif op in ("MaxPool", "AveragePool"):
-                val, shp = self._apply_pool(node, op, ins, env, shapes)
+                val, shp = self._apply_pool(
+                    node, op, ins, env, shapes, nonneg
+                )
+                if ins[0] in nonneg:
+                    nonneg.add(out)
             elif op == "GlobalAveragePool":
                 # NHWC mean over H then W -> (N, C)
                 val = pm.mean(pm.mean(env[ins[0]], axis=1), axis=1)
@@ -188,7 +198,7 @@ class ConvNet(predictor.Predictor):
         )
         return val, shapes[ins[0]]
 
-    def _apply_pool(self, node, op, ins, env, shapes):
+    def _apply_pool(self, node, op, ins, env, shapes, nonneg):
         pool = tuple(int(k) for k in _attr(node, "kernel_shape"))
         # ONNX pooling strides default to 1s (the _ATTR_DEFAULTS entry)
         strides = tuple(int(s) for s in _attr(node, "strides"))
@@ -204,6 +214,14 @@ class ConvNet(predictor.Predictor):
             raise ValueError(
                 "AveragePool with padding requires count_include_pad=1 "
                 "(window sums here always divide by the full pool size)"
+            )
+        if op == "MaxPool" and any(pads) and ins[0] not in nonneg:
+            # zero padding only equals ONNX's -inf padding when the input
+            # cannot be negative (ReLU/Sigmoid-preceded, the ResNet case)
+            raise ValueError(
+                "padded MaxPool requires a provably non-negative input "
+                "(e.g. a preceding Relu); zero padding would otherwise "
+                "override negative border maxima"
             )
         fn = pm.max_pool2d if op == "MaxPool" else pm.avg_pool2d
         val = fn(env[ins[0]], pool, strides=strides, padding=padding)
@@ -236,9 +254,19 @@ class ConvNet(predictor.Predictor):
     def _apply_gemm(self, node, op, ins, env, shapes, dtype):
         init = self.initializers
         w = init[ins[1]]  # already (in, out) (transB undone at import)
+        if op == "Gemm":
+            alpha = float(_attr(node, "alpha", 1.0) or 1.0)
+            beta = float(_attr(node, "beta", 1.0) or 1.0)
+            if alpha != 1.0 or int(_attr(node, "transA", 0) or 0):
+                raise ValueError(
+                    "Gemm with alpha != 1 or transA is not supported"
+                )
+        else:
+            beta = 1.0
         val = pm.dot(env[ins[0]], self._const(w, dtype))
-        if op == "Gemm" and len(ins) > 2:
-            val = pm.add(val, self._const(init[ins[2]].ravel(), dtype))
+        if op == "Gemm" and len(ins) > 2 and beta != 0.0:
+            bias = init[ins[2]].ravel() * beta
+            val = pm.add(val, self._const(bias, dtype))
         return val, (-1, w.shape[1])
 
     def __call__(

@@ -443,7 +443,24 @@ def _parse_line(line: str, comp: Computation):
     )
 
 
-def parse_computation(text: str) -> Computation:
+# Above this size the C++ parallel parser takes over (the reference uses
+# rayon-parallel chunked parsing for the same reason, textual/parsing.rs:83).
+_NATIVE_PARSE_THRESHOLD = 64 << 10
+
+
+def parse_computation(text: str, force_native: Optional[bool] = None
+                      ) -> Computation:
+    use_native = (
+        force_native
+        if force_native is not None
+        else len(text) >= _NATIVE_PARSE_THRESHOLD
+    )
+    if use_native:
+        from .native import textual as native_textual
+
+        records = native_textual.parse_lines(text)
+        if records is not None:
+            return _assemble_from_records(records)
     comp = Computation()
     for lineno, raw in enumerate(text.splitlines(), 1):
         line = raw.strip()
@@ -453,4 +470,78 @@ def parse_computation(text: str) -> Computation:
             _parse_line(line, comp)
         except MalformedComputationError as e:
             raise MalformedComputationError(f"line {lineno}: {e}") from e
+    return comp
+
+
+def _resolve_native_attr(value):
+    """Finish an attribute from the native parser: raw sub-expressions
+    (dtype tokens, tensor literals) go through the Python grammar; lists
+    become the tuples the Python parser produces."""
+    if isinstance(value, dict):
+        if "__raw__" in value and len(value) == 1:
+            return _parse_attr_or_hex(value["__raw__"])
+        raise MalformedComputationError(
+            f"unexpected native attr payload {value!r}"
+        )
+    if isinstance(value, list):
+        return tuple(_resolve_native_attr(v) for v in value)
+    return value
+
+
+def _parse_attr_or_hex(src: str):
+    cur = _Cursor(src)
+    if src.startswith("0x"):
+        m = re.match(r"0x([0-9a-fA-F]+)$", src)
+        if m:
+            return bytes.fromhex(m.group(1))
+    return _parse_attr_value(cur)
+
+
+def _assemble_from_records(records) -> Computation:
+    comp = Computation()
+    ty_cache: dict = {}
+    plc_cache: dict = {}
+
+    def ty_of(src: str) -> Ty:
+        ty = ty_cache.get(src)
+        if ty is None:
+            ty = ty_cache[src] = _parse_ty(_Cursor(src))
+        return ty
+
+    def plc_of(src: str) -> str:
+        name = plc_cache.get(src)
+        if name is None:
+            name = plc_cache[src] = _parse_placement(_Cursor(src), comp)
+        else:
+            # the placement is already registered on comp
+            pass
+        return name
+
+    for entry in records:
+        lineno = entry["l"]  # 1-based source line (comments counted)
+        rec = entry["r"]
+        try:
+            if "__line__" in rec:  # structural fallback: full grammar
+                _parse_line(rec["__line__"], comp)
+                continue
+            attrs = {
+                k: _resolve_native_attr(v) for k, v in rec["a"].items()
+            }
+            comp.add_operation(
+                Operation(
+                    name=rec["n"],
+                    kind=rec["k"],
+                    inputs=list(rec["in"]),
+                    placement_name=plc_of(rec["p"]),
+                    signature=Signature(
+                        tuple(ty_of(t) for t in rec["it"]),
+                        ty_of(rec["rt"]),
+                    ),
+                    attributes=attrs,
+                )
+            )
+        except (MalformedComputationError, ValueError, KeyError) as e:
+            raise MalformedComputationError(
+                f"line {lineno} (native parse): {e}"
+            ) from e
     return comp

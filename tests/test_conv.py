@@ -300,3 +300,31 @@ def test_conv_ops_serde_roundtrip():
             if o.kind == "Transpose" and o.attributes
         )
         assert tuple(tr_op.attributes["axes"]) == (0, 3, 1, 2)
+
+
+def test_compiled_host_pooling_matches_eager():
+    """Host-placed pooling lowers through the SymbolicSession (review
+    regression: direct kernel calls crashed the compiler pipeline)."""
+    alice, *_ = _players()
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(1, 4, 4, 2))
+
+    @pm.computation
+    def comp(xx: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            a = pm.avg_pool2d(xx, (2, 2))
+            m = pm.max_pool2d(xx, (2, 2))
+        return a, m
+
+    runtime = LocalMooseRuntime(["alice"])
+    args = {"xx": x}
+    a, m = runtime.evaluate_computation(
+        comp, arguments=args,
+        compiler_passes=["typing", "lowering", "prune", "toposort"],
+    ).values()
+    np.testing.assert_allclose(
+        a, x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(2, 4)), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        m, x.reshape(1, 2, 2, 2, 2, 2).max(axis=(2, 4)), atol=1e-10
+    )

@@ -48,14 +48,19 @@ def _rep_unary_comp(fn_name, dtype, **kwargs):
 # -- softmax (softmax_test.py) ---------------------------------------------
 
 
-@pytest.mark.parametrize("use_jit", JIT)
+# jit=True traces the full protocol graph through jax (minutes of
+# tracing for the compare-heavy ops) — run the fused path on ONE
+# representative case per family and cover the rest eagerly.
 @pytest.mark.parametrize(
-    "x,axis",
+    "x,axis,use_jit",
     [
-        (np.array([[[1.0, 2, 3], [4, 5, 6]], [[7, 8, 9], [10, 11, 12]]]), 0),
+        (np.array([[[1.0, 2, 3], [4, 5, 6]], [[7, 8, 9], [10, 11, 12]]]),
+         0, True),
+        (np.array([[[1.0, 2, 3], [4, 5, 6]], [[7, 8, 9], [10, 11, 12]]]),
+         0, False),
         (np.array([[-1.38, 3.65, -1.56], [-1.38, 3.65, -1.8],
-                   [-0.64, 0.76, 0.97]]), 1),
-        (np.array([[-0.71, 2.3, -0.74], [0.02, -0.04, 1.08]]), 1),
+                   [-0.64, 0.76, 0.97]]), 1, False),
+        (np.array([[-0.71, 2.3, -0.74], [0.02, -0.04, 1.08]]), 1, False),
     ],
 )
 def test_replicated_softmax(x, axis, use_jit):
@@ -128,22 +133,26 @@ def test_replicated_reduce_max(use_jit):
 # -- exp / log / log2 / sqrt / sigmoid / relu -------------------------------
 
 
-@pytest.mark.parametrize("use_jit", JIT)
+# every function eagerly; the fused-XLA path on `exp` as the family's
+# jit representative (tracing the compare-heavy graphs costs minutes
+# each, and the jit machinery under test is function-independent)
 @pytest.mark.parametrize(
-    "fn,ref,x,atol",
+    "fn,ref,x,atol,use_jit",
     [
         ("exp", np.exp,
-         np.array([[1.0, -2.0], [0.5, -0.25]]), 1e-3),
+         np.array([[1.0, -2.0], [0.5, -0.25]]), 1e-3, True),
+        ("exp", np.exp,
+         np.array([[1.0, -2.0], [0.5, -0.25]]), 1e-3, False),
         ("sqrt", np.sqrt,
-         np.array([[4.0, 9.0], [0.25, 2.0]]), 1e-3),
+         np.array([[4.0, 9.0], [0.25, 2.0]]), 1e-3, False),
         ("sigmoid", lambda v: 1 / (1 + np.exp(-v)),
-         np.array([[1.5, -3.0], [0.0, 4.2]]), 5e-3),
+         np.array([[1.5, -3.0], [0.0, 4.2]]), 5e-3, False),
         ("relu", lambda v: np.maximum(v, 0),
-         np.array([[1.5, -3.0], [0.0, -4.2]]), 1e-6),
+         np.array([[1.5, -3.0], [0.0, -4.2]]), 1e-6, False),
         ("log", np.log,
-         np.array([[1.0, 2.0], [0.5, 8.0]]), 1e-2),
+         np.array([[1.0, 2.0], [0.5, 8.0]]), 1e-2, False),
         ("log2", np.log2,
-         np.array([[1.0, 2.0], [0.5, 8.0]]), 1e-2),
+         np.array([[1.0, 2.0], [0.5, 8.0]]), 1e-2, False),
     ],
 )
 def test_replicated_math(fn, ref, x, atol, use_jit):

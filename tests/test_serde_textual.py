@@ -194,3 +194,82 @@ def test_elk_cli(tmp_path):
     lowered = parse_computation(dst.read_text())
     kinds = {op.kind for op in lowered.operations.values()}
     assert "SampleSeeded" in kinds and "Send" in kinds
+
+
+def test_native_parser_matches_python():
+    """The C++ parallel parser (native/textual_parser.cpp; reference
+    textual/parsing.rs:83 rayon chunked parse) produces computations
+    identical to the Python grammar, including the long tail it forwards
+    as raw payloads (tensor literals, dtype tokens, hex bytes, strings
+    with escapes, nested tuples)."""
+    import numpy as np
+
+    import moose_tpu as pm
+    from moose_tpu.computation import (
+        Computation, HostPlacement, Operation, Signature, Ty,
+    )
+    from moose_tpu import dtypes as dt
+    from moose_tpu.edsl import tracer
+    from moose_tpu.textual import parse_computation, to_textual
+
+    native = pytest.importorskip("moose_tpu.native.textual")
+    if native.load() is None:
+        pytest.skip("native toolchain unavailable")
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            c = pm.constant(np.array([[1.5, -2.0], [0.25, 8.0]]),
+                            dtype=pm.float64)
+            s = pm.constant("key with \"quotes\" and \\ slashes")
+            xf = pm.cast(pm.add(x, c), dtype=pm.fixed(14, 23))
+            pm.save(s, xf)
+        with rep:
+            y = pm.conv2d(
+                pm.reshape(xf, (1, 2, 2, 1)),
+                pm.cast(pm.constant(np.ones((2, 2, 1, 1)),
+                                    dtype=pm.float64),
+                        dtype=pm.fixed(14, 23)),
+                strides=(2, 1), padding=((1, 0), (0, 1)),
+            )
+        with bob:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    traced = tracer.trace(comp)
+    # add a hex-bytes attribute (DeriveSeed-style sync keys)
+    traced.add_placement(HostPlacement("dave"))
+    traced.add_operation(Operation(
+        "seedling", "DeriveSeed", [], "dave",
+        Signature((), Ty("HostSeed")),
+        attributes={"sync_key": b"\x00\xffmoose\x22"},
+    ))
+
+    text = to_textual(traced)
+    py = parse_computation(text, force_native=False)
+    nat = parse_computation(text, force_native=True)
+
+    assert set(py.operations) == set(nat.operations)
+    assert set(py.placements) == set(nat.placements)
+    for name, op1 in py.operations.items():
+        op2 = nat.operations[name]
+        assert (op1.kind, op1.inputs, op1.placement_name) == (
+            op2.kind, op2.inputs, op2.placement_name
+        )
+        assert op1.signature == op2.signature
+        assert set(op1.attributes) == set(op2.attributes)
+        for k, v1 in op1.attributes.items():
+            v2 = op2.attributes[k]
+            if isinstance(v1, np.ndarray):
+                assert np.array_equal(v1, v2)
+            else:
+                assert v1 == v2 and type(v1) is type(v2), (name, k)
+
+    # malformed lines surface the same class of error
+    with pytest.raises(Exception):
+        parse_computation("x = Nope(", force_native=True)
