@@ -195,15 +195,21 @@ void pack_attr_value(Pack* p, const std::string& raw) {
     char* end = nullptr;
     errno = 0;
     long long iv = std::strtoll(v.c_str(), &end, 10);
-    if (errno == 0 && end && *end == '\0' && end != v.c_str()) {
-      p->int64(iv);
-      return;
-    }
-    errno = 0;
-    double dv = std::strtod(v.c_str(), &end);
-    if (errno == 0 && end && *end == '\0' && end != v.c_str()) {
-      p->f64(dv);
-      return;
+    bool int_syntax = end && *end == '\0' && end != v.c_str();
+    if (int_syntax) {
+      if (errno == 0) {
+        p->int64(iv);
+        return;
+      }
+      // integer too wide for int64 (ring scalars): forward raw so
+      // Python keeps arbitrary precision — never degrade to float
+    } else {
+      errno = 0;
+      double dv = std::strtod(v.c_str(), &end);
+      if (errno == 0 && end && *end == '\0' && end != v.c_str()) {
+        p->f64(dv);
+        return;
+      }
     }
   }
   p->map_header(1);

@@ -255,8 +255,8 @@ class ConvNet(predictor.Predictor):
         init = self.initializers
         w = init[ins[1]]  # already (in, out) (transB undone at import)
         if op == "Gemm":
-            alpha = float(_attr(node, "alpha", 1.0) or 1.0)
-            beta = float(_attr(node, "beta", 1.0) or 1.0)
+            alpha = float(_attr(node, "alpha", 1.0))
+            beta = float(_attr(node, "beta", 1.0))
             if alpha != 1.0 or int(_attr(node, "transA", 0) or 0):
                 raise ValueError(
                     "Gemm with alpha != 1 or transA is not supported"

@@ -1,0 +1,128 @@
+"""Randomized property tests for protocol identities (reference uses
+proptest in additive/trunc.rs, fixedpoint/ops.rs and replicated/mod.rs —
+same discipline here with seeded numpy draws over full-range ring
+tensors, many trials per property)."""
+
+import numpy as np
+import pytest
+
+import moose_tpu  # noqa: F401
+from moose_tpu.computation import ReplicatedPlacement
+from moose_tpu.dialects import replicated, ring
+from moose_tpu.execution.session import EagerSession
+from moose_tpu.values import HostRingTensor, to_numpy
+
+M = {64: 1 << 64, 128: 1 << 128}
+rep = ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+
+TRIALS = 8
+
+
+def _rand_ints(rng, n, width):
+    return np.array(
+        [int.from_bytes(rng.bytes(width // 8), "little") for _ in range(n)],
+        dtype=object,
+    )
+
+
+def _tensor(ints, width, plc="alice"):
+    lo, hi = ring.from_python_ints(np.asarray(ints, dtype=object), width)
+    return HostRingTensor(lo, hi, width, plc)
+
+
+def _ints(x):
+    return np.vectorize(int, otypes=[object])(
+        np.asarray(to_numpy(x), dtype=object)
+    )
+
+
+@pytest.mark.parametrize("width", [64, 128])
+def test_share_reveal_identity_random(width):
+    """reveal(share(x)) == x over full-range random ring values."""
+    rng = np.random.default_rng(100 + width)
+    sess = EagerSession()
+    for _ in range(TRIALS):
+        vals = _rand_ints(rng, 5, width)
+        xs = replicated.share(sess, rep, _tensor(vals, width))
+        out = replicated.reveal(sess, rep, xs, "carole")
+        np.testing.assert_array_equal(_ints(out), vals)
+
+
+@pytest.mark.parametrize("width", [64, 128])
+def test_secure_ring_is_homomorphic(width):
+    """reveal(share(x) op share(y)) == (x op y) mod 2^k for add/sub/mul."""
+    rng = np.random.default_rng(200 + width)
+    sess = EagerSession()
+    for _ in range(TRIALS):
+        a = _rand_ints(rng, 4, width)
+        b = _rand_ints(rng, 4, width)
+        xs = replicated.share(sess, rep, _tensor(a, width))
+        ys = replicated.share(sess, rep, _tensor(b, width))
+        for fn, ref in (
+            (replicated.add, lambda u, v: (u + v) % M[width]),
+            (replicated.sub, lambda u, v: (u - v) % M[width]),
+            (replicated.mul, lambda u, v: (u * v) % M[width]),
+        ):
+            out = replicated.reveal(
+                sess, rep, fn(sess, rep, xs, ys), "alice"
+            )
+            np.testing.assert_array_equal(_ints(out), ref(a, b))
+
+
+@pytest.mark.parametrize("width", [64, 128])
+def test_trunc_pr_error_bound_random(width):
+    """TruncPr(x, f) is within 1 of x >> f for |x| < 2^(k-2) — the
+    probabilistic-truncation contract the fixed-point stack relies on
+    (reference replicated/fixedpoint.rs)."""
+    rng = np.random.default_rng(300 + width)
+    sess = EagerSession()
+    f = 20
+    bound = 1 << (width - 2)
+    for _ in range(TRIALS):
+        mags = [
+            int.from_bytes(rng.bytes((width - 2) // 8), "little")
+            % (bound - 1)
+            for _ in range(4)
+        ]
+        signed = [m if i % 2 == 0 else -m for i, m in enumerate(mags)]
+        vals = np.array([v % M[width] for v in signed], dtype=object)
+        xs = replicated.share(sess, rep, _tensor(vals, width))
+        out = replicated.reveal(
+            sess, rep, replicated.trunc_pr(sess, rep, xs, f), "bob"
+        )
+        got = _ints(out)
+        for g, v in zip(got, signed):
+            gs = g - M[width] if g >= M[width] // 2 else g
+            expect = v >> f  # arithmetic shift (floor division)
+            assert abs(gs - expect) <= 1, (v, gs, expect)
+
+
+@pytest.mark.parametrize("width", [64, 128])
+def test_bit_decompose_compose_identity_random(width):
+    """compose(decompose(x)) == x on random ring values (host level)."""
+    rng = np.random.default_rng(400 + width)
+    sess = EagerSession()
+    for _ in range(TRIALS):
+        vals = _rand_ints(rng, 3, width)
+        x = _tensor(vals, width)
+        bits = sess.decompose_bits("alice", x)
+        back = sess.compose_bits("alice", bits, width)
+        np.testing.assert_array_equal(_ints(back), vals)
+
+
+def test_fixed_encode_decode_roundtrip_random():
+    """decode(encode(x)) == x exactly for values within the mantissa
+    budget (reference fixedpoint host kernels)."""
+    rng = np.random.default_rng(7)
+    sess = EagerSession()
+    for width, f in ((64, 23), (128, 40)):
+        for _ in range(TRIALS):
+            x = np.round(rng.normal(size=6) * 100, 4)
+            from moose_tpu.values import HostTensor
+            from moose_tpu import dtypes as dt
+
+            h = HostTensor(np.asarray(x), "alice", dt.float64)
+            enc = sess.ring_fixedpoint_encode("alice", h, f, width)
+            dec = sess.ring_fixedpoint_decode("alice", enc, f, dt.float64)
+            got = np.asarray(to_numpy(dec))
+            np.testing.assert_allclose(got, x, atol=2.0 ** -f)
