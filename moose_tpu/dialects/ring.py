@@ -453,6 +453,11 @@ def _limbs8_s8_centered(x, n_limbs: int):
     ]
 
 
+# Whole diagonals accumulate exactly in int32 when
+# pairs_per_diag * k * 255^2 < 2^31 (pairs <= 16 for <= 16 limbs):
+_INT8_I32_DIAG_MAX_K = 2047
+
+
 def _int8_pair_diags(la, lb, out_limbs: int, k: int):
     """Per-diagonal sums S_s = sum_{i+j=s} A_i . B_j over centered s8 limb
     lists, as u64 arrays.
@@ -461,14 +466,19 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
     (limb - 128) and each product de-centered with rank-1 corrections:
       A_i . B_j = A'_i . B'_j + 128*(rowsum(A'_i) + colsum(B'_j)) + 128^2*k
     Centered products accumulate exactly in s32 for k <= 2^17, so unlike
-    the f32 path no chunking is needed; corrections are O(m+n) vectors
-    accumulated in s64.  On v5e int8 matmul runs at 2x bf16 throughput.
+    the f32 path no chunking is needed.  On v5e int8 matmul runs at 2x
+    bf16 throughput.  For small contractions (k <= 2047) the de-centered
+    values and whole diagonal sums still fit int32, so the 64-bit work
+    (emulated 32-bit pairs on TPU) shrinks to one widening per diagonal;
+    larger k accumulates per-pair in s64.
     """
     in_limbs = len(la)
     # de-centering correction vectors, exact in s32 (k*128 < 2^31)
     ra = [jnp.sum(x.astype(jnp.int32), axis=-1) for x in la]  # (m,)
     cb = [jnp.sum(x.astype(jnp.int32), axis=0) for x in lb]  # (n,)
-    bias = np.int64(128 * 128 * k)
+    i32_diag = k <= _INT8_I32_DIAG_MAX_K
+    acc_ty = jnp.int32 if i32_diag else jnp.int64
+    bias = acc_ty(128 * 128 * k)
     m, n = la[0].shape[0], lb[0].shape[-1]
     diags = []
     for s in range(out_limbs):
@@ -480,17 +490,24 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
             p = jax.lax.dot_general(
                 la[i], lb[j], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
-            ).astype(jnp.int64)
+            ).astype(acc_ty)
             p = p + (
-                np.int64(128)
-                * (ra[i][:, None] + cb[j][None, :]).astype(jnp.int64)
+                acc_ty(128) * (ra[i][:, None] + cb[j][None, :]).astype(
+                    acc_ty
+                )
                 + bias
             )
-            pi = p.astype(U64)
-            ps = pi if ps is None else ps + pi
-        diags.append(
-            ps if ps is not None else jnp.zeros((m, n), dtype=U64)
-        )
+            if not i32_diag:
+                p = p.astype(U64)
+            ps = p if ps is None else ps + p
+        if ps is None:
+            diags.append(jnp.zeros((m, n), dtype=U64))
+        elif i32_diag:
+            # single widening per diagonal; values are exact non-negative
+            # int32, so the s64 intermediate is sign-safe
+            diags.append(ps.astype(jnp.int64).astype(U64))
+        else:
+            diags.append(ps)
     return diags
 
 

@@ -190,3 +190,22 @@ class TestSampling:
         lo, hi = ring.sample_uniform_seeded((8,), seed, 128)
         assert hi is not None
         assert not np.array_equal(np.asarray(lo), np.asarray(hi))
+
+
+@pytest.mark.parametrize("k", [2047, 2048])
+def test_matmul128_int8_i32_diag_boundary(k):
+    """Worst-case operands (all-0xFF limbs) at the int32-diagonal
+    accumulation boundary (k=2047 uses the i32 fast path, k=2048 the s64
+    path) stay bit-exact."""
+    m, n = 2, 2
+    ones = np.full((m, k), np.uint64(0xFFFFFFFFFFFFFFFF), dtype=np.uint64)
+    onesb = np.full((k, n), np.uint64(0xFFFFFFFFFFFFFFFF), dtype=np.uint64)
+    full = (1 << 128) - 1
+    expected = np.full((m, n), (full * full * k) % (1 << 128), dtype=object)
+    ring.set_matmul_strategy("limb_int8")
+    try:
+        lo, hi = ring.matmul(ones, ones, onesb, onesb)
+    finally:
+        ring.set_matmul_strategy(None)
+    got = as_int128(lo, hi)
+    np.testing.assert_array_equal(got, expected)
