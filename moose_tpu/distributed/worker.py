@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..computation import Computation
+from ..computation import Computation, HostPlacement
 from ..errors import KernelError, MissingArgumentError, StorageError
 from ..execution.physical import execute_kernel
 from ..execution.session import EagerSession
@@ -52,6 +52,36 @@ def execute_role(
 
     t0 = time.perf_counter()
     arguments = arguments or {}
+    composite = [
+        plc.name for plc in comp.placements.values()
+        if not isinstance(plc, HostPlacement)
+    ]
+    if composite:
+        # a logical graph would silently skip every replicated op (no
+        # worker owns the composite placement) and fail later with an
+        # opaque missing-operand error
+        raise KernelError(
+            "worker received an uncompiled computation (composite "
+            f"placements {composite}); compile it first — e.g. "
+            "`elk compile --passes typing,lowering,prune,networking,"
+            "toposort`"
+        )
+    for op in comp.operations.values():
+        plc_name = comp.placement_of(op).name
+        for inp in op.inputs:
+            src = comp.operations[inp]
+            if (
+                comp.placement_of(src).name != plc_name
+                and op.kind != "Receive"
+            ):
+                # cross-host edge with no Send/Receive stitched in — the
+                # networking pass was skipped
+                raise KernelError(
+                    f"op {op.name} on {plc_name} reads {inp} from "
+                    f"{comp.placement_of(src).name} without a "
+                    "Send/Receive pair; run the `networking` compiler "
+                    "pass before deploying"
+                )
     sess = EagerSession(session_id=session_id)
     env: dict = {}
     outputs: dict = {}

@@ -397,3 +397,91 @@ def test_comet_cluster_multiprocess(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_rudolph_filesystem_choreography(tmp_path):
+    """rudolph's launch-from-file path (reference
+    choreography/filesystem.rs): a .session TOML names a textual
+    computation + role table; every worker launches its role and the
+    results are retrieved over choreography."""
+    import json
+
+    from moose_tpu.bin.rudolph import _launch_from_file
+    from moose_tpu.distributed.choreography import (
+        ChoreographyClient,
+        WorkerServer,
+    )
+    from moose_tpu.textual import to_textual
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(3, 2))
+    w = rng.normal(size=(2, 1))
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments({"x": x, "w": w}),
+    )
+    (tmp_path / "comp.moose").write_text(to_textual(compiled))
+    (tmp_path / "args.json").write_text(
+        json.dumps({"x": x.tolist(), "w": w.tolist()})
+    )
+
+    servers, endpoints = {}, {}
+    try:
+        for i in ("alice", "bob", "carole"):
+            srv = WorkerServer(i, 0, {}).start()
+            servers[i] = srv
+            endpoints[i] = f"127.0.0.1:{srv.port}"
+
+        session = tmp_path / "run.session"
+        session.write_text(
+            'session_id = "rudolph-1"\n'
+            'arguments = "args.json"\n'
+            "[computation]\n"
+            'path = "comp.moose"\n'
+            "[roles]\n"
+            + "".join(f'{k} = "{v}"\n' for k, v in endpoints.items())
+        )
+
+        import logging
+
+        log = logging.getLogger("test-rudolph")
+        for srv in servers.values():
+            _launch_from_file(srv, session, log)
+
+        outputs = {}
+        for name, endpoint in endpoints.items():
+            result = ChoreographyClient(endpoint).retrieve(
+                "rudolph-1", timeout=60.0
+            )
+            assert "error" not in result, (name, result)
+            from moose_tpu.serde import deserialize_value
+
+            for out_name, blob in (result.get("outputs") or {}).items():
+                outputs[out_name] = deserialize_value(blob)
+        (val,) = outputs.values()
+        np.testing.assert_allclose(np.asarray(val), x @ w, atol=1e-4)
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_worker_rejects_uncompiled_and_unnetworked_graphs():
+    from moose_tpu.compilation import compile_computation
+    from moose_tpu.distributed.networking import LocalNetworking
+    from moose_tpu.errors import KernelError
+
+    traced = tracer.trace(_secure_dot_comp())
+    with pytest.raises(KernelError, match="uncompiled"):
+        execute_role(traced, "alice", {}, {}, LocalNetworking(), "s-x")
+
+    x = np.ones((2, 2))
+    w = np.ones((2, 1))
+    lowered = compile_computation(
+        traced, ["typing", "lowering", "prune", "toposort"],  # no networking
+        arg_specs=arg_specs_from_arguments({"x": x, "w": w}),
+    )
+    with pytest.raises(KernelError, match="networking"):
+        execute_role(
+            lowered, "alice", {}, {"x": x, "w": w},
+            LocalNetworking(), "s-y",
+        )
