@@ -98,6 +98,13 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
 
     import weakref
 
+    from .. import telemetry
+
+    # Per-op spans (reference: one tracing span per async op task) are
+    # meaningful only in eager mode — under jit the whole graph is one
+    # XLA program and Python-side timers would be traced away.
+    trace_ops = telemetry.trace_ops_enabled() and not use_jit
+
     # The closure must not keep the computation alive: the compiled plan is
     # cached weak-keyed on the computation, so a strong capture here would
     # make eviction impossible.  While any caller can invoke `core` it also
@@ -150,7 +157,13 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
                 outputs[name] = value
                 continue
             args = [env[i] for i in op.inputs]
-            env[name] = logical.execute_op(sess, comp, op, args)
+            if trace_ops:
+                # same-named spans aggregate in phase_timings, giving a
+                # per-kind time profile of the eager run
+                with telemetry.span(f"op:{op.kind}"):
+                    env[name] = logical.execute_op(sess, comp, op, args)
+            else:
+                env[name] = logical.execute_op(sess, comp, op, args)
         return outputs, saves
 
     return _Plan(order, static_env, dynamic_names, use_jit, core)

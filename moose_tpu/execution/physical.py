@@ -273,7 +273,11 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
 
     import weakref
 
+    from .. import telemetry
+
     comp_ref = weakref.ref(comp)
+    # per-op spans in eager mode only (see interpreter.build_plan)
+    trace_ops = telemetry.trace_ops_enabled() and not use_jit
 
     def core(keys: dict, dyn: dict):
         import jax.numpy as jnp
@@ -325,7 +329,11 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
                 outputs[n] = value
                 continue
             args = [env[i] for i in op.inputs]
-            env[n] = execute_kernel(sess, op, plc, args)
+            if trace_ops:
+                with telemetry.span(f"op:{op.kind}"):
+                    env[n] = execute_kernel(sess, op, plc, args)
+            else:
+                env[n] = execute_kernel(sess, op, plc, args)
         return outputs, saves
 
     fn = jax.jit(core) if use_jit else core

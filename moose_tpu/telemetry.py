@@ -84,6 +84,12 @@ def _echo_enabled() -> bool:
     return os.environ.get("MOOSE_TPU_TRACE", "0") not in ("0", "")
 
 
+def trace_ops_enabled() -> bool:
+    """Per-op spans in eager execution (MOOSE_TPU_TRACE_OPS; read when a
+    computation's plan is built)."""
+    return os.environ.get("MOOSE_TPU_TRACE_OPS", "0") not in ("0", "")
+
+
 @contextmanager
 def span(name: str, **attrs):
     """Record a timed span; nests under the enclosing span, if any."""

@@ -89,3 +89,40 @@ def test_report_renders_tree(capsys):
     text = buf.getvalue()
     assert "root:" in text
     assert "  child:" in text
+
+
+def test_eager_per_op_spans(monkeypatch):
+    """MOOSE_TPU_TRACE_OPS=1 records per-kind op spans in eager mode
+    (reference: one tracing span per async op task)."""
+    monkeypatch.setenv("MOOSE_TPU_TRACE_OPS", "1")
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))):
+        with alice:
+            y = pm.mul(pm.add(x, x), x)
+        return y
+
+    runtime = LocalMooseRuntime(["alice"], use_jit=False)
+    runtime.evaluate_computation(comp, arguments={"x": np.ones((3,))})
+    t = runtime.last_timings
+    assert "op:Add" in t and "op:Mul" in t, t
+
+
+def test_eager_per_op_spans_compiled_path(monkeypatch):
+    """The physical executor's eager loop records per-op spans too."""
+    monkeypatch.setenv("MOOSE_TPU_TRACE_OPS", "1")
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))):
+        with alice:
+            y = pm.add(x, x)
+        return y
+
+    runtime = LocalMooseRuntime(["alice"], use_jit=False)
+    runtime.evaluate_computation(
+        comp, arguments={"x": np.ones((2,))},
+        compiler_passes=["typing", "lowering", "prune", "toposort"],
+    )
+    assert "op:Add" in runtime.last_timings, runtime.last_timings
