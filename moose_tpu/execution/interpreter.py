@@ -159,9 +159,15 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
             args = [env[i] for i in op.inputs]
             if trace_ops:
                 # same-named spans aggregate in phase_timings, giving a
-                # per-kind time profile of the eager run
+                # per-kind time profile of the eager run.  jax dispatch
+                # is async, so the span must force materialization or
+                # the device time would be misattributed to whichever
+                # later op first blocks (tracing is opt-in; the sync
+                # cost is the price of honest per-op numbers)
                 with telemetry.span(f"op:{op.kind}"):
-                    env[name] = logical.execute_op(sess, comp, op, args)
+                    env[name] = jax.block_until_ready(
+                        logical.execute_op(sess, comp, op, args)
+                    )
             else:
                 env[name] = logical.execute_op(sess, comp, op, args)
         return outputs, saves

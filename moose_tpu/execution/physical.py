@@ -330,8 +330,12 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
                 continue
             args = [env[i] for i in op.inputs]
             if trace_ops:
+                # block inside the span: async dispatch would otherwise
+                # misattribute device time (see interpreter.build_plan)
                 with telemetry.span(f"op:{op.kind}"):
-                    env[n] = execute_kernel(sess, op, plc, args)
+                    env[n] = jax.block_until_ready(
+                        execute_kernel(sess, op, plc, args)
+                    )
             else:
                 env[n] = execute_kernel(sess, op, plc, args)
         return outputs, saves
