@@ -55,8 +55,11 @@ class WorkerServer:
                 "choreographer authorization requires a TlsConfig — "
                 "without mTLS there is no verified peer identity"
             )
+        import collections
+
         self.networking = GrpcNetworking(identity, self.endpoints, tls=tls)
-        self._sessions: dict = {}
+        self._sessions: dict = {}  # session id -> cancel Event
+        self._aborted: "collections.deque[str]" = collections.deque()
         self._results = _CellStore()
         self._lock = threading.Lock()
         self._server = None
@@ -85,10 +88,17 @@ class WorkerServer:
 
         msg = _unpack(request)
         session_id = msg["session_id"]
+        cancel = threading.Event()
         with self._lock:
+            if session_id in self._aborted:
+                # abort raced ahead of launch (gRPC retry/reordering):
+                # honor it — never start the session
+                raise SessionAlreadyExistsError(
+                    f"{session_id} (aborted before launch)"
+                )
             if session_id in self._sessions:
                 raise SessionAlreadyExistsError(session_id)
-            self._sessions[session_id] = "running"
+            self._sessions[session_id] = cancel
         comp = deserialize_computation(msg["computation"])
         arguments = {
             name: deserialize_value(blob)
@@ -101,25 +111,25 @@ class WorkerServer:
             try:
                 result = execute_role(
                     comp, self.identity, self.storage, arguments,
-                    self.networking, session_id,
+                    self.networking, session_id, cancel=cancel,
                 )
-                outputs = {
-                    name: _serialize_output(value)
-                    for name, value in result["outputs"].items()
-                }
-                self._results.put(
-                    session_id,
-                    _pack({
-                        "outputs": outputs,
-                        "elapsed_time_micros": result[
-                            "elapsed_time_micros"
-                        ],
-                    }),
-                )
+                payload = _pack({
+                    "outputs": {
+                        name: _serialize_output(value)
+                        for name, value in result["outputs"].items()
+                    },
+                    "elapsed_time_micros": result["elapsed_time_micros"],
+                })
             except Exception as e:  # surfaced on retrieve
-                self._results.put(
-                    session_id, _pack({"error": f"{type(e).__name__}: {e}"})
-                )
+                payload = _pack({"error": f"{type(e).__name__}: {e}"})
+            # an aborted session already has its canonical
+            # {"error": "aborted"} result; putting again would either
+            # clobber it or recreate a never-consumed cell.  The check
+            # and put happen under the same lock as _abort's add+put so
+            # the two cannot interleave.
+            with self._lock:
+                if session_id not in self._aborted:
+                    self._results.put(session_id, payload)
 
         threading.Thread(target=run, daemon=True).start()
         return _pack({"ok": True})
@@ -132,17 +142,52 @@ class WorkerServer:
         timeout = float(msg.get("timeout", 120.0))
         return self._results.get(msg["session_id"], timeout)
 
+    # bound on remembered aborted ids (replay/late-send protection); old
+    # entries age out FIFO so a long-lived worker's state stays bounded
+    _MAX_ABORTED = 4096
+
     def _abort(self, request: bytes, context=None) -> bytes:
         self._check_choreographer(context)
         msg = _unpack(request)
+        session_id = msg["session_id"]
         with self._lock:
-            self._sessions.pop(msg["session_id"], None)
-        # fail-stop semantics: mark the result cell so retrievers unblock
-        self._results.put(msg["session_id"], _pack({"error": "aborted"}))
+            self._aborted.append(session_id)
+            while len(self._aborted) > self._MAX_ABORTED:
+                self._aborted.popleft()
+            known = session_id in self._sessions
+            cancel = self._sessions.pop(session_id, None)
+            if known:
+                # fail-stop semantics: retrievers of a launched session
+                # unblock with the canonical error.  Unknown ids get no
+                # cell (nobody retrieves a session that never launched;
+                # a cell would be retained forever).
+                self._results.put(
+                    session_id, _pack({"error": "aborted"})
+                )
+        if cancel is not None:
+            # cooperative cancellation: the execute thread checks the
+            # event between ops and inside blocked receives
+            # (the reference's abort handler is unimplemented!(),
+            # choreography/grpc.rs:200-205)
+            cancel.set()
+        # drop pending rendezvous payloads so aborted sessions don't
+        # retain undelivered tensors in a long-lived worker
+        self.networking.cells.drop_session(session_id)
         return _pack({"ok": True})
 
     def _send_value(self, request: bytes, context=None) -> bytes:
-        return self.networking.handle_send_value(request, context)
+        # a peer's send may land after this worker aborted the session:
+        # drop it up front so cancelled receives never retain the payload
+        # (complements the one-shot GC in _abort)
+        frame = _unpack(request)
+        session_id = frame.get("key", "").split("/", 1)[0]
+        with self._lock:
+            aborted = session_id in self._aborted
+        if aborted:
+            return b""
+        return self.networking.handle_send_value(
+            request, context, frame=frame
+        )
 
     # -- server lifecycle ----------------------------------------------
 

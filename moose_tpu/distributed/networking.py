@@ -32,6 +32,37 @@ def transfer_key(session_id: str, rendezvous_key: str) -> str:
     return f"{session_id}/{rendezvous_key}"
 
 
+def sliced_wait(wait_slice, timeout: float, cancel, what: str) -> None:
+    """Wait for ``wait_slice(seconds) -> bool`` to report arrival.
+
+    With no cancel event this is one full-length wait; with one, the wait
+    runs in <=200ms slices and a set event interrupts a blocked receive
+    promptly — checked both before and after each slice so an abort in
+    the final slice is reported as cancellation, not a spurious timeout.
+    Shared by every transport so the semantics can't drift."""
+    import time as _time
+
+    if cancel is None:
+        if not wait_slice(timeout):
+            raise NetworkingError(
+                f"receive timed out after {timeout}s for {what!r}"
+            )
+        return
+    deadline = _time.monotonic() + timeout
+    while True:
+        if cancel.is_set():
+            raise NetworkingError(
+                f"receive for {what!r} cancelled (session aborted)"
+            )
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            raise NetworkingError(
+                f"receive timed out after {timeout}s for {what!r}"
+            )
+        if wait_slice(min(0.2, remaining)):
+            return
+
+
 class _CellStore:
     """Rendezvous-keyed blocking cells: receive may be posted before the
     send arrives (reference AsyncCell store, networking/grpc.rs:189-207)."""
@@ -49,20 +80,33 @@ class _CellStore:
                 ev = self._events[key] = threading.Event()
         ev.set()
 
-    def get(self, key: str, timeout: float):
+    def get(self, key: str, timeout: float, cancel=None):
         with self._lock:
             ev = self._events.get(key)
             if ev is None:
                 ev = self._events[key] = threading.Event()
-        if not ev.wait(timeout):
-            raise NetworkingError(
-                f"receive timed out after {timeout}s for {key!r}"
-            )
+        sliced_wait(ev.wait, timeout, cancel, key)
         with self._lock:
             # single-consumer: drop the cell after use (sessions never
             # reuse a rendezvous key)
             self._events.pop(key, None)
             return self._values.pop(key)
+
+    def drop_session(self, session_id: str) -> int:
+        """Remove every pending cell of one session (abort-path GC —
+        payloads that arrived for a cancelled receive would otherwise be
+        retained forever in a long-lived worker)."""
+        prefix = f"{session_id}/"
+        with self._lock:
+            stale = [k for k in self._events if k.startswith(prefix)]
+            stale += [
+                k for k in self._values
+                if k.startswith(prefix) and k not in self._events
+            ]
+            for k in stale:
+                self._events.pop(k, None)
+                self._values.pop(k, None)
+        return len(stale)
 
 
 class LocalNetworking:
@@ -84,11 +128,12 @@ class LocalNetworking:
         self._store.put(transfer_key(session_id, rendezvous_key), payload)
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
-                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S):
+                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
+                cancel=None):
         from ..serde import deserialize_value
 
         payload = self._store.get(
-            transfer_key(session_id, rendezvous_key), timeout
+            transfer_key(session_id, rendezvous_key), timeout, cancel
         )
         if self._serialize:
             return deserialize_value(payload, plc)
@@ -151,7 +196,8 @@ class TcpNetworking:
                 delay = min(delay * 2, 2.0)
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
-                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S):
+                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
+                cancel=None):
         from ..serde import deserialize_value
 
         if self._server is None:
@@ -159,10 +205,32 @@ class TcpNetworking:
                 "TcpNetworking.receive before start(): the local server "
                 "owns the rendezvous store"
             )
-        payload = self._server.receive(
-            transfer_key(session_id, rendezvous_key), int(timeout * 1000)
-        )
-        return deserialize_value(payload, plc)
+        key = transfer_key(session_id, rendezvous_key)
+        box: list = []
+
+        def wait_slice(seconds: float) -> bool:
+            # the native wait is uninterruptible, so slices bound how
+            # long a cancel can go unnoticed.  If the native call ever
+            # returned early without a value, the sleep keeps the loop
+            # paced instead of busy-spinning.
+            import time as _time
+
+            t0 = _time.monotonic()
+            try:
+                box.append(
+                    self._server.receive(key, max(1, int(seconds * 1000)))
+                )
+                return True
+            except NetworkingError as e:
+                if "timed out" not in str(e):
+                    raise
+                elapsed = _time.monotonic() - t0
+                if elapsed < seconds / 2:
+                    _time.sleep(seconds - elapsed)
+                return False
+
+        sliced_wait(wait_slice, timeout, cancel, key)
+        return deserialize_value(box[0], plc)
 
 
 class GrpcNetworking:
@@ -199,14 +267,17 @@ class GrpcNetworking:
                 self._channels[receiver] = ch
             return ch.unary_unary("/moose.Networking/SendValue")
 
-    def handle_send_value(self, request: bytes, context=None) -> bytes:
-        """Server-side handler: unpack (key ‖ value) frame and post it.
+    def handle_send_value(self, request: bytes, context=None,
+                          frame=None) -> bytes:
+        """Server-side handler: unpack (key ‖ value) frame and post it
+        (``frame`` lets a caller that already unpacked skip the repeat).
 
         Under mTLS the claimed sender must match the peer certificate's CN
         (reference networking/grpc.rs:150-160 rejects spoofed senders)."""
         import msgpack
 
-        frame = msgpack.unpackb(request, raw=False)
+        if frame is None:
+            frame = msgpack.unpackb(request, raw=False)
         if self._tls is not None:
             from .tls import peer_common_name, reject
 
@@ -270,10 +341,11 @@ class GrpcNetworking:
                 delay = min(delay * 2, 2.0)
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
-                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S):
+                plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
+                cancel=None):
         from ..serde import deserialize_value
 
         payload = self.cells.get(
-            transfer_key(session_id, rendezvous_key), timeout
+            transfer_key(session_id, rendezvous_key), timeout, cancel
         )
         return deserialize_value(payload, plc)

@@ -36,9 +36,16 @@ def execute_role(
     networking,
     session_id: str,
     timeout: float = 120.0,
+    cancel=None,
 ) -> dict:
     """Execute ``identity``'s share of a lowered computation; returns
-    {"outputs": {...}, "elapsed_time_micros": int}."""
+    {"outputs": {...}, "elapsed_time_micros": int}.
+
+    ``cancel``: optional ``threading.Event`` — checked between ops and
+    inside blocked receives (sliced waits) so an AbortComputation can
+    actually stop a running session (the reference leaves its abort
+    handler unimplemented, choreography/grpc.rs:200-205).
+    """
     import jax.numpy as jnp
 
     from ..execution.interpreter import _lift_array, _to_user_value
@@ -87,6 +94,8 @@ def execute_role(
     outputs: dict = {}
 
     for name in comp.toposort_names():
+        if cancel is not None and cancel.is_set():
+            raise KernelError(f"session {session_id} aborted")
         op = comp.operations[name]
         plc = comp.placement_of(op)
         if plc.name != identity:
@@ -108,6 +117,7 @@ def execute_role(
                 session_id,
                 plc=identity,
                 timeout=timeout,
+                cancel=cancel,
             )
             continue
         if kind == "PrfKeyGen":

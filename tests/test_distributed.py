@@ -485,3 +485,58 @@ def test_worker_rejects_uncompiled_and_unnetworked_graphs():
             lowered, "alice", {}, {"x": x, "w": w},
             LocalNetworking(), "s-y",
         )
+
+
+def test_abort_cancels_running_session():
+    """AbortComputation stops a running session: retrievers unblock with
+    an 'aborted' error and the execute thread exits at the next op
+    boundary (the reference's abort handler is unimplemented)."""
+    import msgpack
+
+    from moose_tpu.distributed.choreography import WorkerServer
+    from moose_tpu.errors import KernelError
+    from moose_tpu.serde import serialize_computation
+
+    # cooperative cancel at the worker level: a pre-set event aborts
+    # before the first op executes
+    x = np.ones((2, 2))
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments({"x": x, "w": x[:, :1]}),
+    )
+    ev = threading.Event()
+    ev.set()
+    with pytest.raises(KernelError, match="aborted"):
+        execute_role(
+            compiled, "alice", {}, {"x": x, "w": x[:, :1]},
+            LocalNetworking(), "s-abort", cancel=ev,
+        )
+
+    # end-to-end: launch on one worker WITH its argument so it advances
+    # into a blocked Receive (the other parties never launch), abort,
+    # and both the retriever and the blocked execute thread unwind fast
+    from moose_tpu.serde import serialize_value
+
+    srv = WorkerServer("alice", 0, {}).start()
+    try:
+        srv.endpoints["alice"] = f"127.0.0.1:{srv.port}"
+        srv.networking._endpoints.update(srv.endpoints)
+        blob = serialize_computation(compiled)
+        srv._launch(msgpack.packb(
+            {"session_id": "ab-1", "computation": blob,
+             "arguments": {"x": serialize_value(x)}},
+            use_bin_type=True,
+        ))
+        import time as _t
+
+        _t.sleep(1.0)  # let the thread reach its blocked Receive
+        srv._abort(msgpack.packb({"session_id": "ab-1"},
+                                 use_bin_type=True))
+        t0 = _t.monotonic()
+        result = msgpack.unpackb(
+            srv._results.get("ab-1", timeout=10.0), raw=False
+        )
+        assert "error" in result and "abort" in result["error"], result
+        assert _t.monotonic() - t0 < 5.0
+    finally:
+        srv.stop()
