@@ -1,0 +1,29 @@
+"""elk_compiler: compile serialized computations (reference
+``pymoose/src/bindings.rs:403-419`` exposes the Rust compiler to Python as
+``elk_compiler.compile_computation(bytes, passes)``; here the compiler is
+native Python/JAX so this is a thin adapter over
+:mod:`moose_tpu.compilation`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def compile_computation(comp_bin: bytes, passes: Optional[list] = None,
+                        arg_specs: Optional[dict] = None) -> bytes:
+    """Deserialize a msgpack computation, run compiler passes, and return
+    the compiled computation re-serialized (the reference returns an
+    opaque MooseComputation handle; bytes serve the same role here and
+    feed ``LocalMooseRuntime.evaluate_compiled`` directly).
+
+    ``arg_specs`` supplies the static shapes the lowering pass needs
+    (XLA's compilation model): ``{input_name: ((shape...), np_dtype)}``.
+    Passes that require no shapes (typing, prune, toposort, wellformed,
+    dot, dump) work without it.
+    """
+    from .compilation import compile_computation as _compile
+    from .serde import deserialize_computation, serialize_computation
+
+    comp = deserialize_computation(comp_bin)
+    compiled = _compile(comp, passes=passes, arg_specs=arg_specs)
+    return serialize_computation(compiled)

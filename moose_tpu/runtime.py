@@ -70,6 +70,8 @@ class LocalMooseRuntime:
         from .execution.physical import PhysicalInterpreter
 
         self._physical = PhysicalInterpreter()
+        # serialized-computation memo for evaluate_compiled (see there)
+        self._bin_cache: Dict[bytes, Computation] = {}
         # phase timings (micros) of the most recent evaluate_computation
         self.last_timings: Dict[str, int] = {}
 
@@ -160,10 +162,47 @@ class LocalMooseRuntime:
             computation, self.storage, arguments, use_jit=self.use_jit
         )
 
+    # op kinds that only a lowered (host-level) graph contains — the
+    # positive marker for routing to the physical executor.  All-host
+    # graphs WITHOUT these are plain logical computations and keep the
+    # logical path (which knows AddN, Softmax, ...).
+    _LOWERED_KINDS = frozenset({
+        "RingFixedpointEncode", "RingFixedpointDecode",
+        "RingFixedpointMean", "PrfKeyGen", "DeriveSeed", "SampleSeeded",
+        "Sample", "Send", "Receive", "RingInject", "BitCompose",
+        "BitDecompose", "BitExtract", "Shl", "Shr", "Fill", "ShlDim",
+        "Im2Col",
+    })
+
     def evaluate_compiled(self, comp_bin, arguments=None):
         from .serde import deserialize_computation
 
-        comp = deserialize_computation(comp_bin)
+        # memoize deserialization strongly by the (hashable) bytes: the
+        # Computation object keys the physical interpreter's weak plan
+        # cache, so a fresh object per call would re-jit every time
+        comp = self._bin_cache.get(comp_bin)
+        if comp is None:
+            comp = deserialize_computation(comp_bin)
+            self._bin_cache[comp_bin] = comp
+            while len(self._bin_cache) > 32:  # bounded LRU
+                self._bin_cache.pop(next(iter(self._bin_cache)))
+        lowered = any(
+            op.kind in self._LOWERED_KINDS
+            for op in comp.operations.values()
+        )
+        if lowered:
+            # already-compiled host-level graphs (elk_compiler output)
+            # execute on the physical interpreter; the logical dialect
+            # doesn't know host-level ring ops
+            from . import telemetry
+
+            with telemetry.span("evaluate_compiled") as root:
+                result = self._physical.evaluate(
+                    comp, self.storage, dict(arguments or {}),
+                    use_jit=self.use_jit,
+                )
+            self.last_timings = telemetry.phase_timings(root)
+            return result
         return self.evaluate_computation(comp, arguments)
 
     def read_value_from_storage(self, identity: str, key: str):
