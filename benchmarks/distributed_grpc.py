@@ -1,0 +1,232 @@
+"""3-worker gRPC cluster benchmark: the reference's ACTUAL deployment
+shape (3 comet processes + a coordinating client,
+/root/reference/benchmarks/README.md:1-24) — genuinely-distrusting
+parties, per-party processes, real serde + gRPC on every cross-party
+edge, parallel dependency-counted execution inside each worker.
+
+The reference's headline 1000x1000 secure dot is 5.910 s in this shape
+(3x c5.9xlarge).  Workers here are CPU-pinned (several processes cannot
+share the one tunneled TPU chip) and colocated on one host, which is
+honest-to-pessimistic: all three parties contend for the same cores,
+whereas the reference gave each party 36 dedicated vCPUs.
+
+  python benchmarks/distributed_grpc.py --mode dot --size 1000
+  python benchmarks/distributed_grpc.py --all
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE_PORT = int(os.environ.get("MOOSE_TPU_BENCH_PORT", "22300"))
+IDENTITIES = ["alice", "bob", "carole"]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("MOOSE_TPU_PRF", "threefry")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def spawn_workers(base_port=BASE_PORT):
+    endpoints = {
+        name: f"127.0.0.1:{base_port + i}"
+        for i, name in enumerate(IDENTITIES)
+    }
+    ep_spec = ",".join(f"{k}={v}" for k, v in endpoints.items())
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "moose_tpu.bin.comet",
+             "--identity", name, "--port", str(base_port + i),
+             "--endpoints", ep_spec],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env,
+        )
+        for i, name in enumerate(IDENTITIES)
+    ]
+    import grpc
+
+    try:
+        deadline = time.time() + 60
+        for ep in endpoints.values():
+            while True:
+                ch = grpc.insecure_channel(ep)
+                try:
+                    grpc.channel_ready_future(ch).result(timeout=5)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"worker at {ep} failed to start"
+                        )
+                finally:
+                    ch.close()
+    except BaseException:
+        _teardown(procs)  # don't leak spawned workers on startup failure
+        raise
+    return procs, endpoints
+
+
+def _teardown(procs):
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def build_dot_comp(pm, n_seq):
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement(name="rep", players=[alice, bob, carole])
+    fixed = pm.fixed(8, 27)
+
+    @pm.computation
+    def dot_product_comp(
+        x_arg: pm.Argument(placement=alice, dtype=pm.float64),
+        y_arg: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x = pm.cast(x_arg, dtype=fixed)
+        with bob:
+            y = pm.cast(y_arg, dtype=fixed)
+        with rep:
+            z = pm.dot(x, y)
+            for _ in range(n_seq - 1):
+                z = pm.dot(x, z)
+        with carole:
+            res = pm.cast(z, dtype=pm.float64)
+        return res
+
+    return dot_product_comp
+
+
+def bench_dot(runtime, pm, size, n_seq, iters):
+    comp = build_dot_comp(pm, n_seq)
+    rng = np.random.default_rng(42)
+    # square x so chained dots keep their shapes; normalize to avoid
+    # fixed-point overflow over the chain
+    x = rng.uniform(0.5, 1.5, size=(size, size)) / max(size, 1)
+    y = rng.uniform(0.5, 1.5, size=(size, size))
+    args = {"x_arg": x, "y_arg": y}
+    runtime.evaluate_computation(comp, args)  # warm XLA caches everywhere
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        outputs, _ = runtime.evaluate_computation(comp, args)
+        times.append(time.perf_counter() - t0)
+    (out,) = outputs.values()
+    expected = x @ y
+    for _ in range(n_seq - 1):
+        expected = x @ expected
+    err = float(np.max(np.abs(np.asarray(out) - expected)))
+    assert err < 1e-2 * max(1.0, float(np.max(np.abs(expected)))), err
+    return {
+        "metric": f"grpc_dot_{size}x{size}_seq{n_seq}",
+        "value": round(statistics.median(times), 4),
+        "unit": "s",
+        "min": round(min(times), 4),
+        "max": round(max(times), 4),
+        "iters": iters,
+    }
+
+
+def bench_logreg(runtime, pm, batch_size, n_iter, iters):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import logreg as lr
+
+    comp = lr.build_train(batch_size, 1)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(batch_size, lr.N_FEATURES))
+    w_true = rng.normal(size=(lr.N_FEATURES, 1))
+    y = (1 / (1 + np.exp(-(x @ w_true))) > 0.5).astype(np.float64)
+    w0 = np.zeros((lr.N_FEATURES, 1))
+    b0 = np.zeros((1,))
+    args = {"x": x, "y": y, "w_0": w0, "b_0": b0}
+    # n_iter epochs are driven by re-running the one-batch step graph:
+    # the distributed walk executes ops eagerly, so a 10-iteration
+    # unrolled graph and 10 runs of the step graph cost the same ops;
+    # the step graph keeps launch payloads small
+    runtime.evaluate_computation(comp, args)  # warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            outputs, _ = runtime.evaluate_computation(comp, args)
+        times.append(time.perf_counter() - t0)
+    return {
+        "metric": f"grpc_logreg_b{batch_size}_i{n_iter}",
+        "value": round(statistics.median(times), 4),
+        "unit": "s",
+        "min": round(min(times), 4),
+        "max": round(max(times), 4),
+        "iters": iters,
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mode", choices=["dot", "logreg"], default="dot")
+    parser.add_argument("--size", type=int, default=1000)
+    parser.add_argument("--n_seq", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--n_iter", type=int, default=10)
+    parser.add_argument("--iters", type=int, default=3)
+    parser.add_argument("--all", action="store_true",
+                        help="reproduce the reference's table cells")
+    args = parser.parse_args()
+
+    # the client compiles/serializes only — CPU is fine and avoids
+    # fighting the workers for the tunneled chip
+    os.environ.setdefault("MOOSE_TPU_PRF", "threefry")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import moose_tpu as pm
+    from moose_tpu.runtime import GrpcMooseRuntime
+
+    procs, endpoints = spawn_workers()
+    try:
+        runtime = GrpcMooseRuntime(endpoints)
+        rows = []
+        if args.all:
+            for size in (1, 10, 100, 1000):
+                rows.append(bench_dot(runtime, pm, size, 1, args.iters))
+                print(json.dumps(rows[-1]), flush=True)
+            for size in (1, 10, 100):
+                rows.append(bench_dot(runtime, pm, size, 10, args.iters))
+                print(json.dumps(rows[-1]), flush=True)
+            rows.append(bench_logreg(runtime, pm, 128, 10, args.iters))
+            print(json.dumps(rows[-1]), flush=True)
+        elif args.mode == "dot":
+            rows.append(bench_dot(
+                runtime, pm, args.size, args.n_seq, args.iters
+            ))
+            print(json.dumps(rows[-1]), flush=True)
+        else:
+            rows.append(bench_logreg(
+                runtime, pm, args.batch_size, args.n_iter, args.iters
+            ))
+            print(json.dumps(rows[-1]), flush=True)
+    finally:
+        _teardown(procs)
+
+
+if __name__ == "__main__":
+    main()

@@ -424,11 +424,14 @@ def pow2_from_bits(sess, rep, bits: Sequence[RepTensor], width: int) -> RepTenso
     return sels[0]
 
 
-def _pow2_positive(sess, rep, x_abs: RepTensor, i_p: int, f_p: int) -> RepTensor:
+def _pow2_positive(sess, rep, x_abs: RepTensor, i_p: int, f_p: int,
+                   int_bound_bits: Optional[int] = None) -> RepTensor:
     """2^x for a NON-NEGATIVE secret fixed-point value (raw ring shares at
     scale f).  The sign/reciprocal handling of ``pow2`` is factored out so
-    callers that already know the sign (sigmoid) can skip the expensive
-    division branch entirely."""
+    callers that already know the sign (sigmoid, and pow2's own shifted
+    form) can skip it.  ``int_bound_bits`` bounds the bit-length of the
+    integer part when the caller knows it exceeds i_p (the shifted-pow2
+    input reaches i_p + f_p)."""
     k = i_p + f_p
     width = _width_of(x_abs)
 
@@ -438,7 +441,8 @@ def _pow2_positive(sess, rep, x_abs: RepTensor, i_p: int, f_p: int) -> RepTensor
     # bit_length(width - f) select only overflowed values — skipping them
     # changes nothing for in-range inputs and cuts the multiply chain from
     # i_p (e.g. 24) to ~log2(width) (7) selects.
-    n_int = min(i_p, width - f_p, max(1, (width - f_p).bit_length()))
+    bound = int_bound_bits if int_bound_bits is not None else i_p
+    n_int = min(bound, width - f_p, max(1, (width - f_p).bit_length()))
     int_bits = rep_ops.slice_axis0(sess, rep, abs_bits, f_p, f_p + n_int)
     int_ring = rep_ops.b2a_bits(sess, rep, int_bits, width)
     higher = [
@@ -466,34 +470,49 @@ def _pow2_positive(sess, rep, x_abs: RepTensor, i_p: int, f_p: int) -> RepTensor
     return rep_ops.trunc_pr(sess, rep, e_prod, amount)
 
 
-def pow2(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
-    """2^x for secret fixed-point x (exp.rs:11-112)."""
+def pow2(sess, rep, x: RepFixedTensor,
+         lower_bounded: bool = False) -> RepFixedTensor:
+    """2^x for secret fixed-point x of EITHER sign, without the
+    reference's reciprocal branch (exp.rs:11-112 computes 1/2^|x| via a
+    full Goldschmidt division for negative inputs — roughly half of
+    exp's protocol size): 2^x = 2^(x + f) * 2^-f, where x + f >= 0 after
+    clamping x below at -f (where 2^x underflows fixed(i, f) to 0
+    anyway), and the final 2^-f factor is a plain ring shift-truncation.
+    Ring headroom: the shifted result raw value is 2^(x + 2f) <
+    2^(i + 2f) <= 2^width (guaranteed by the same 2(i+f) <= width bound
+    division imposes).
+
+    ``lower_bounded=True`` skips the clamp when the caller already
+    guarantees x >= -f (softmax clamps at its underflow threshold)."""
     i_p = x.integral_precision
     f_p = x.fractional_precision
+    k = i_p + f_p
     width = _width_of(x.tensor)
 
-    msb_bit = rep_ops.msb(sess, rep, x.tensor)
-    m_ring = rep_ops.b2a(sess, rep, msb_bit, width)
-    abs_x = rep_ops.mux_ring(
-        sess, rep, m_ring, rep_ops.neg(sess, rep, x.tensor), x.tensor
+    t = x.tensor
+    if not lower_bounded:
+        floor_raw = encode_const(-float(f_p), f_p, width)
+        shp = _shape_of(sess, rep, t)
+        floor_t = rep_ops.fill(sess, rep, shp, floor_raw, width)
+        under = rep_ops.greater(sess, rep, floor_t, t)
+        t = rep_ops.mux_bit(sess, rep, under, floor_t, t)
+    shifted = add_public_raw(
+        sess, rep, t, encode_const(float(f_p), f_p, width)
     )
-
-    g = _pow2_positive(sess, rep, abs_x, i_p, f_p)
-    g_fixed = RepFixedTensor(g, i_p, f_p)
-
-    # negative exponent -> 1 / 2^|x|
-    one_fixed = RepFixedTensor(
-        fill_public(sess, rep, x.tensor, 1 << f_p), i_p, f_p
+    g = _pow2_positive(
+        sess, rep, shifted, i_p, f_p,
+        int_bound_bits=max(1, k.bit_length()),
     )
-    inverse = div(sess, rep, one_fixed, g_fixed)
-    switched = rep_ops.mux_ring(sess, rep, m_ring, inverse.tensor, g)
-    return RepFixedTensor(switched, i_p, f_p)
+    # g = 2^(x+f) at scale f; shift back down by f: 2^x at scale f
+    out = rep_ops.trunc_pr(sess, rep, g, f_p)
+    return RepFixedTensor(out, i_p, f_p)
 
 
-def exp(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
+def exp(sess, rep, x: RepFixedTensor,
+        lower_bounded: bool = False) -> RepFixedTensor:
     """e^x = 2^(x * log2(e))."""
     scaled = mul_public_float(sess, rep, x, math.log2(math.e))
-    return pow2(sess, rep, scaled)
+    return pow2(sess, rep, scaled, lower_bounded=lower_bounded)
 
 
 # ---------------------------------------------------------------------------
@@ -612,19 +631,50 @@ def sigmoid(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
 # ---------------------------------------------------------------------------
 
 
+def _stack_rep(sess, rep, xs: Sequence[RepTensor]) -> RepTensor:
+    expanded = [
+        rep_ops.expand_dims(sess, rep, x, axis=0) for x in xs
+    ]
+    if len(expanded) == 1:
+        return expanded[0]
+    return rep_ops.concat(sess, rep, expanded, axis=0)
+
+
 def maximum_ring(sess, rep, xs: Sequence[RepTensor]) -> RepTensor:
-    """Tournament max via less + mux (softmax.rs:10-54)."""
+    """Tournament max via less + mux (softmax.rs:10-54), one STACKED
+    comparison per round: all pairs of a round are concatenated on a
+    fresh leading axis so each round costs one bit-decompose comparison
+    regardless of field size — ceil(log2 n) comparisons total instead of
+    n-1 (the dominant cost of a comparison is the secure adder, whose
+    protocol size is shape-independent)."""
     n = len(xs)
     if n < 1:
         from ..errors import KernelError
 
         raise KernelError("maximum requires at least one operand")
-    if n == 1:
-        return xs[0]
-    a = maximum_ring(sess, rep, xs[: n // 2])
-    b = maximum_ring(sess, rep, xs[n // 2 :])
-    lt = rep_ops.less(sess, rep, a, b)
-    return rep_ops.mux_bit(sess, rep, lt, b, a)
+    xs = list(xs)
+    # stacking needs uniform shapes; broadcast-compatible mixed shapes
+    # keep the pairwise elementwise path (less/mux broadcast per share)
+    uniform = len({tuple(x.shape) for x in xs}) == 1
+    while len(xs) > 1:
+        m = len(xs) // 2
+        carry = xs[2 * m:]
+        evens, odds = xs[0:2 * m:2], xs[1:2 * m:2]
+        if m == 1 or not uniform:
+            nxt = []
+            for a, b in zip(evens, odds):
+                lt = rep_ops.less(sess, rep, a, b)
+                nxt.append(rep_ops.mux_bit(sess, rep, lt, b, a))
+            xs = nxt + list(carry)
+            continue
+        a = _stack_rep(sess, rep, evens)
+        b = _stack_rep(sess, rep, odds)
+        lt = rep_ops.less(sess, rep, a, b)
+        mx = rep_ops.mux_bit(sess, rep, lt, b, a)
+        xs = [
+            rep_ops.index_axis(sess, rep, mx, 0, i) for i in range(m)
+        ] + list(carry)
+    return xs[0]
 
 
 def maximum(sess, rep, xs: Sequence[RepFixedTensor]) -> RepFixedTensor:
@@ -636,28 +686,41 @@ def maximum(sess, rep, xs: Sequence[RepFixedTensor]) -> RepFixedTensor:
 
 def argmax_ring(sess, rep, x: RepTensor, axis: int, upmost_index: int) -> RepTensor:
     """Tournament argmax over (index, value) pairs (argmax.rs:6-47);
-    indices are public fills carried through muxes."""
+    indices are public fills carried through muxes.  Rounds are stacked
+    like :func:`maximum_ring`: one comparison + one b2a per round."""
     width = _width_of(x)
-    pairs = []
-    for i in range(upmost_index):
-        v = rep_ops.index_axis(sess, rep, x, axis, i)
-        idx = fill_public(sess, rep, v, i)
-        pairs.append((idx, v))
+    vals = [
+        rep_ops.index_axis(sess, rep, x, axis, i)
+        for i in range(upmost_index)
+    ]
+    idxs = [fill_public(sess, rep, v, i) for i, v in enumerate(vals)]
 
-    def reduce(items):
-        n = len(items)
-        if n == 1:
-            return items[0]
-        a = reduce(items[: n // 2])
-        b = reduce(items[n // 2 :])
-        lt = rep_ops.less(sess, rep, a[1], b[1])
+    while len(vals) > 1:
+        m = len(vals) // 2
+        carry_v, carry_i = vals[2 * m:], idxs[2 * m:]
+        if m == 1:
+            av, bv = vals[0], vals[1]
+            ai, bi = idxs[0], idxs[1]
+            lt = rep_ops.less(sess, rep, av, bv)
+            s = rep_ops.b2a(sess, rep, lt, width)
+            vals = [rep_ops.mux_ring(sess, rep, s, bv, av)] + list(carry_v)
+            idxs = [rep_ops.mux_ring(sess, rep, s, bi, ai)] + list(carry_i)
+            continue
+        av = _stack_rep(sess, rep, vals[0:2 * m:2])
+        bv = _stack_rep(sess, rep, vals[1:2 * m:2])
+        ai = _stack_rep(sess, rep, idxs[0:2 * m:2])
+        bi = _stack_rep(sess, rep, idxs[1:2 * m:2])
+        lt = rep_ops.less(sess, rep, av, bv)
         s = rep_ops.b2a(sess, rep, lt, width)
-        return (
-            rep_ops.mux_ring(sess, rep, s, b[0], a[0]),
-            rep_ops.mux_ring(sess, rep, s, b[1], a[1]),
-        )
-
-    return reduce(pairs)[0]
+        nv = rep_ops.mux_ring(sess, rep, s, bv, av)
+        ni = rep_ops.mux_ring(sess, rep, s, bi, ai)
+        vals = [
+            rep_ops.index_axis(sess, rep, nv, 0, i) for i in range(m)
+        ] + list(carry_v)
+        idxs = [
+            rep_ops.index_axis(sess, rep, ni, 0, i) for i in range(m)
+        ] + list(carry_i)
+    return idxs[0]
 
 
 def argmax(sess, rep, x: RepFixedTensor, axis: int, upmost_index: int) -> RepTensor:
@@ -681,10 +744,19 @@ def softmax(
         rep_ops.expand_dims(sess, rep, xmax.tensor, axis=axis), i_p, f_p
     )
     diff = sub(sess, rep, x, xmax_e)
-    e_x = exp(sess, rep, diff)
 
-    # threshold: -(ln 2^(i_p - 1)); below it 2^diff underflows -> clamp to 0
-    min_val = -1.0 * math.log(2.0 ** (i_p - 1))
+    # threshold: -(ln 2^min(i_p - 1, f_p)); below it e^diff underflows
+    # the OUTPUT encoding (2^-f is the smallest positive fixed value, and
+    # the reference's own bound is 2^-(i_p-1)) -> clamp the INPUT there
+    # first, so exp can take its shifted positive-only path (diff <= 0
+    # and, after the clamp, diff*log2(e) >= -f_p — no reciprocal branch,
+    # no second comparison).  The f_p term matters when i_p - 1 > f_p:
+    # without it the clamp would pass values below exp's shifted-domain
+    # floor and _pow2_positive would wrap.  The -1 gives one power-of-two
+    # of headroom so the few ulps of encode_const/trunc_pr rounding
+    # between the clamp (on diff) and exp's internal log2(e) scaling
+    # cannot push a barely-unclamped element below -f_p
+    min_val = -1.0 * math.log(2.0) * min(i_p - 1, f_p - 1)
     width = _width_of(x.tensor)
     lower_raw = encode_const(min_val, f_p, width)
     lower = RepFixedTensor(
@@ -693,6 +765,11 @@ def softmax(
         f_p,
     )
     gt = rep_ops.greater(sess, rep, lower.tensor, diff.tensor)
+    clamped = RepFixedTensor(
+        rep_ops.mux_bit(sess, rep, gt, lower.tensor, diff.tensor), i_p, f_p
+    )
+    e_x = exp(sess, rep, clamped, lower_bounded=True)
+
     zeros = RepFixedTensor(
         rep_ops.fill(sess, rep, _shape_of(sess, rep, e_x.tensor), 0, width),
         i_p,

@@ -6,6 +6,20 @@ AbortComputation, with per-session result cells and duplicate-session
 protection.  gRPC methods carry raw msgpack bytes (no protoc codegen
 needed; the reference uses tonic+prost — the method *names* and semantics
 match, the payload codec is msgpack like the rest of this framework).
+
+Failure discipline (beyond the reference, whose abort handler is
+``unimplemented!()``, choreography/grpc.rs:200-205):
+
+- **abort fanout**: the first worker to hit a root-cause error aborts the
+  session on every peer via a participant-level AbortSession rpc, so a
+  3-party protocol fails fast everywhere instead of leaving two parties
+  blocked in receives until timeout (the reference's
+  ``join_on_first_error`` does this within one process,
+  execution/asynchronous.rs:27-74; we extend it across workers);
+- **failure detector**: while a session runs, each worker pings its peers;
+  a peer that stops answering for ``ping_misses`` consecutive rounds
+  fails the session locally and fans the abort out to the survivors — a
+  killed worker is detected in ~``ping_misses * ping_interval`` seconds.
 """
 
 from __future__ import annotations
@@ -16,13 +30,19 @@ from typing import Optional
 
 import msgpack
 
-from ..errors import NetworkingError, SessionAlreadyExistsError
+from ..errors import (
+    NetworkingError,
+    SessionAbortedError,
+    SessionAlreadyExistsError,
+)
 from .networking import GrpcNetworking, _CellStore
 
 LAUNCH = "/moose.Choreography/LaunchComputation"
 RETRIEVE = "/moose.Choreography/RetrieveResults"
 ABORT = "/moose.Choreography/AbortComputation"
 SEND_VALUE = "/moose.Networking/SendValue"
+ABORT_SESSION = "/moose.Networking/AbortSession"
+PING = "/moose.Networking/Ping"
 
 
 def _pack(obj) -> bytes:
@@ -33,6 +53,26 @@ def _unpack(data: bytes):
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
 
 
+class _SessionState:
+    """Book-keeping for one running session."""
+
+    __slots__ = ("cancel", "peers", "abort_reason", "progress")
+
+    def __init__(self, peers):
+        from .networking import ProgressClock
+
+        self.cancel = threading.Event()
+        self.peers = list(peers)
+        # set when the cancel came from outside (choreographer or peer
+        # fanout) so the run thread records the root cause, not a bare
+        # "aborted"
+        self.abort_reason: Optional[str] = None
+        # receives extend their deadline while this advances; bumped by
+        # local op completions AND successful peer pings, so a party
+        # idling while live peers crunch a long pipeline never times out
+        self.progress = ProgressClock()
+
+
 class WorkerServer:
     """One worker daemon: hosts the choreography service and the gRPC
     networking endpoint, executes its role of launched sessions in
@@ -40,7 +80,9 @@ class WorkerServer:
 
     def __init__(self, identity: str, port: int, endpoints: dict,
                  storage: Optional[dict] = None, tls=None,
-                 choreographer: Optional[str] = None):
+                 choreographer: Optional[str] = None,
+                 ping_interval: float = 0.5, ping_misses: int = 3,
+                 startup_grace: float = 30.0):
         self.identity = identity
         self.port = port
         self.endpoints = dict(endpoints)
@@ -55,11 +97,19 @@ class WorkerServer:
                 "choreographer authorization requires a TlsConfig — "
                 "without mTLS there is no verified peer identity"
             )
+        # failure-detector cadence; interval <= 0 disables the detector.
+        # startup_grace: how long an as-yet-never-reachable peer is
+        # tolerated (workers may come up in any order); once a peer has
+        # answered one ping, ping_misses consecutive failures trip.
+        self.ping_interval = ping_interval
+        self.ping_misses = ping_misses
+        self.startup_grace = startup_grace
         import collections
 
         self.networking = GrpcNetworking(identity, self.endpoints, tls=tls)
-        self._sessions: dict = {}  # session id -> cancel Event
+        self._sessions: dict = {}  # session id -> _SessionState (running)
         self._aborted: "collections.deque[str]" = collections.deque()
+        self._completed: "collections.deque[str]" = collections.deque()
         self._results = _CellStore()
         self._lock = threading.Lock()
         self._server = None
@@ -84,11 +134,19 @@ class WorkerServer:
         return self._launch_inner(request)
 
     def _launch_inner(self, request: bytes) -> bytes:
+        from ..computation import HostPlacement
         from ..serde import deserialize_computation, deserialize_value
 
         msg = _unpack(request)
         session_id = msg["session_id"]
-        cancel = threading.Event()
+        comp = deserialize_computation(msg["computation"])
+        peers = [
+            plc.name for plc in comp.placements.values()
+            if isinstance(plc, HostPlacement)
+            and plc.name != self.identity
+            and plc.name in self.endpoints
+        ]
+        state = _SessionState(peers)
         with self._lock:
             if session_id in self._aborted:
                 # abort raced ahead of launch (gRPC retry/reordering):
@@ -96,10 +154,9 @@ class WorkerServer:
                 raise SessionAlreadyExistsError(
                     f"{session_id} (aborted before launch)"
                 )
-            if session_id in self._sessions:
+            if session_id in self._sessions or session_id in self._completed:
                 raise SessionAlreadyExistsError(session_id)
-            self._sessions[session_id] = cancel
-        comp = deserialize_computation(msg["computation"])
+            self._sessions[session_id] = state
         arguments = {
             name: deserialize_value(blob)
             for name, blob in (msg.get("arguments") or {}).items()
@@ -108,10 +165,12 @@ class WorkerServer:
         def run():
             from .worker import execute_role
 
+            fanout_reason = None
             try:
                 result = execute_role(
                     comp, self.identity, self.storage, arguments,
-                    self.networking, session_id, cancel=cancel,
+                    self.networking, session_id, cancel=state.cancel,
+                    progress=state.progress,
                 )
                 payload = _pack({
                     "outputs": {
@@ -120,18 +179,38 @@ class WorkerServer:
                     },
                     "elapsed_time_micros": result["elapsed_time_micros"],
                 })
-            except Exception as e:  # surfaced on retrieve
-                payload = _pack({"error": f"{type(e).__name__}: {e}"})
-            # an aborted session already has its canonical
-            # {"error": "aborted"} result; putting again would either
-            # clobber it or recreate a never-consumed cell.  The check
-            # and put happen under the same lock as _abort's add+put so
-            # the two cannot interleave.
+            except SessionAbortedError:
+                # someone else's root cause cancelled us; the initiator
+                # already fanned out and (if it was this server) already
+                # put the canonical error cell
+                payload = _pack({
+                    "error": state.abort_reason or "aborted",
+                })
+            except Exception as e:  # surfaced on retrieve + fanned out
+                fanout_reason = f"{type(e).__name__}: {e}"
+                payload = _pack({"error": fanout_reason})
+            # an aborted session already has its canonical error result;
+            # putting again would either clobber it or recreate a
+            # never-consumed cell.  The check and put happen under the
+            # same lock as _abort's add+put so the two cannot interleave.
             with self._lock:
+                self._sessions.pop(session_id, None)
                 if session_id not in self._aborted:
                     self._results.put(session_id, payload)
+                    self._completed.append(session_id)
+                    while len(self._completed) > self._MAX_ABORTED:
+                        self._completed.popleft()
+            if fanout_reason is not None:
+                self._fanout_abort(session_id, fanout_reason, state.peers)
 
         threading.Thread(target=run, daemon=True).start()
+        if peers and self.ping_interval > 0:
+            threading.Thread(
+                target=self._failure_detector,
+                args=(session_id, state),
+                daemon=True,
+                name=f"moose-fd-{session_id[:8]}",
+            ).start()
         return _pack({"ok": True})
 
     def _retrieve(self, request: bytes, context=None) -> bytes:
@@ -142,51 +221,205 @@ class WorkerServer:
         timeout = float(msg.get("timeout", 120.0))
         return self._results.get(msg["session_id"], timeout)
 
-    # bound on remembered aborted ids (replay/late-send protection); old
-    # entries age out FIFO so a long-lived worker's state stays bounded
+    # bound on remembered aborted/completed ids (replay/late-send
+    # protection); old entries age out FIFO so a long-lived worker's
+    # state stays bounded
     _MAX_ABORTED = 4096
 
     def _abort(self, request: bytes, context=None) -> bytes:
         self._check_choreographer(context)
         msg = _unpack(request)
-        session_id = msg["session_id"]
+        self._abort_local(msg["session_id"], reason="aborted")
+        return _pack({"ok": True})
+
+    def _abort_local(self, session_id: str, reason: str) -> None:
+        """Shared abort path (choreographer rpc, peer fanout, failure
+        detector): cancel a running session, record the canonical error
+        cell, remember the id so late launches/sends are dropped.  An
+        already-completed session keeps its real result."""
         with self._lock:
+            completed = session_id in self._completed
+            state = self._sessions.pop(session_id, None)
             self._aborted.append(session_id)
             while len(self._aborted) > self._MAX_ABORTED:
                 self._aborted.popleft()
-            known = session_id in self._sessions
-            cancel = self._sessions.pop(session_id, None)
-            if known:
+            if state is not None:
                 # fail-stop semantics: retrievers of a launched session
                 # unblock with the canonical error.  Unknown ids get no
                 # cell (nobody retrieves a session that never launched;
-                # a cell would be retained forever).
-                self._results.put(
-                    session_id, _pack({"error": "aborted"})
-                )
-        if cancel is not None:
-            # cooperative cancellation: the execute thread checks the
+                # a cell would be retained forever), completed ones keep
+                # their real result.
+                state.abort_reason = reason
+                self._results.put(session_id, _pack({"error": reason}))
+        if state is not None:
+            # cooperative cancellation: the execute threads check the
             # event between ops and inside blocked receives
             # (the reference's abort handler is unimplemented!(),
             # choreography/grpc.rs:200-205)
-            cancel.set()
-        # drop pending rendezvous payloads so aborted sessions don't
-        # retain undelivered tensors in a long-lived worker
-        self.networking.cells.drop_session(session_id)
+            state.cancel.set()
+        if not completed:
+            # drop pending rendezvous payloads so aborted sessions don't
+            # retain undelivered tensors in a long-lived worker
+            self.networking.cells.drop_session(session_id)
+
+    def _fanout_abort(self, session_id: str, reason: str, peers) -> None:
+        """Propagate a root-cause error: abort the session on every peer
+        (best effort, parallel, short timeout — a dead peer is precisely
+        the case we're propagating around)."""
+        msg = f"aborted by {self.identity}: {reason}"
+
+        def one(peer):
+            # two attempts: a transient failure here would otherwise
+            # leave the peer relying on its (slower) failure detector
+            for attempt in range(2):
+                try:
+                    self.networking.abort_session(peer, session_id, msg)
+                    return
+                except Exception:  # noqa: BLE001 — peer may be the dead one
+                    if attempt == 0:
+                        import time
+
+                        time.sleep(0.2)
+
+        threads = [
+            threading.Thread(target=one, args=(p,), daemon=True)
+            for p in peers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+
+    def _abort_session(self, request: bytes, context=None) -> bytes:
+        """Participant-level abort (peer fanout target).  Under mTLS the
+        claimed sender must match the peer certificate's CN and be a
+        configured participant — a choreographer credential is NOT
+        required: any party that hit a root cause may fail the session."""
+        msg = _unpack(request)
+        sender = msg.get("sender")
+        if self.tls is not None:
+            from .tls import peer_common_name, reject
+
+            peer = (
+                peer_common_name(context) if context is not None else None
+            )
+            if peer is None or peer != sender or peer not in self.endpoints:
+                reject(
+                    context,
+                    f"unauthorized session abort: claimed {sender!r}, "
+                    f"peer certificate CN {peer!r}",
+                )
+        self._abort_local(
+            msg["session_id"], reason=msg.get("reason", "aborted by peer")
+        )
         return _pack({"ok": True})
+
+    def _ping(self, request: bytes, context=None) -> bytes:
+        msg = _unpack(request) if request else {}
+        session_id = msg.get("session_id")
+        status = None
+        if session_id is not None:
+            with self._lock:
+                if session_id in self._sessions:
+                    status = "running"
+                elif session_id in self._aborted:
+                    status = "aborted"
+                elif session_id in self._completed:
+                    status = "completed"
+                else:
+                    status = "unknown"
+        return _pack({
+            "ok": True, "identity": self.identity, "session": status,
+        })
+
+    def _failure_detector(self, session_id: str, state: _SessionState):
+        """Ping session peers while the session runs; a consistently
+        unreachable peer fails the session everywhere.  Two kinds of
+        miss are weighted differently: a connection-level failure
+        (UNAVAILABLE — process dead, port closed) scores 2, a slow
+        answer (deadline exceeded — peer alive but saturated, common on
+        small shared hosts) scores 1, and the session fails at
+        ``2 * ping_misses`` points — so a killed worker is detected in
+        ~``ping_misses * ping_interval`` seconds while a busy-but-alive
+        peer gets twice the patience.  Peers that were never reachable
+        get ``startup_grace`` seconds first (workers come up in any
+        order)."""
+        import time
+
+        import grpc
+
+        start = time.monotonic()
+        misses = {p: 0 for p in state.peers}
+        seen = {p: False for p in state.peers}
+        trip_at = 2 * self.ping_misses
+        while True:
+            time.sleep(self.ping_interval)
+            with self._lock:
+                if session_id not in self._sessions:
+                    return  # session finished or was aborted
+            for peer in state.peers:
+                if state.cancel.is_set():
+                    return
+                try:
+                    resp = self.networking.ping(
+                        peer, timeout=3.0, session_id=session_id
+                    )
+                    seen[peer] = True
+                    misses[peer] = 0
+                    peer_session = resp.get("session")
+                    if peer_session == "aborted":
+                        # the peer killed this session but its fanout
+                        # never reached us: adopt the abort instead of
+                        # treating the live process as session liveness
+                        reason = (
+                            f"session aborted on peer {peer!r} "
+                            "(learned via ping)"
+                        )
+                        self._abort_local(session_id, reason=reason)
+                        return
+                    if peer_session in ("running", "completed"):
+                        # genuine liveness for OUR session: extend
+                        # blocked receives.  "unknown" (launch not yet
+                        # arrived, or state aged out) deliberately does
+                        # not extend — the hard timeout backstop stays
+                        state.progress.bump()
+                except Exception as e:  # noqa: BLE001 — rpc failure
+                    if (
+                        not seen[peer]
+                        and time.monotonic() - start < self.startup_grace
+                    ):
+                        continue
+                    hard = (
+                        isinstance(e, grpc.RpcError)
+                        and e.code() == grpc.StatusCode.UNAVAILABLE
+                    )
+                    misses[peer] += 2 if hard else 1
+                    if misses[peer] >= trip_at:
+                        reason = (
+                            f"peer {peer!r} unreachable "
+                            f"({misses[peer]} ping-miss points)"
+                        )
+                        self._abort_local(session_id, reason=reason)
+                        survivors = [
+                            p for p in state.peers if p != peer
+                        ]
+                        self._fanout_abort(session_id, reason, survivors)
+                        return
 
     def _send_value(self, request: bytes, context=None) -> bytes:
         # a peer's send may land after this worker aborted the session:
-        # drop it up front so cancelled receives never retain the payload
-        # (complements the one-shot GC in _abort)
+        # drop it so cancelled receives never retain the payload — but
+        # only after the mTLS sender check, so a spoofed frame is
+        # rejected (not silently ACKed) on this path too
         frame = _unpack(request)
+        self.networking.verify_sender(frame, context)
         session_id = frame.get("key", "").split("/", 1)[0]
         with self._lock:
             aborted = session_id in self._aborted
         if aborted:
             return b""
         return self.networking.handle_send_value(
-            request, context, frame=frame
+            request, context, frame=frame, verified=True
         )
 
     # -- server lifecycle ----------------------------------------------
@@ -206,9 +439,16 @@ class WorkerServer:
             "RetrieveResults": unary(self._retrieve),
             "AbortComputation": unary(self._abort),
         }
-        net_handlers = {"SendValue": unary(self._send_value)}
+        net_handlers = {
+            "SendValue": unary(self._send_value),
+            "AbortSession": unary(self._abort_session),
+            "Ping": unary(self._ping),
+        }
+        from .networking import GRPC_MESSAGE_OPTIONS
+
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=16)
+            futures.ThreadPoolExecutor(max_workers=16),
+            options=GRPC_MESSAGE_OPTIONS,
         )
         self._server.add_generic_rpc_handlers(
             (
@@ -266,7 +506,11 @@ class ChoreographyClient:
                 )
             self._channel = tls.secure_channel(endpoint, expected_identity)
         else:
-            self._channel = grpc.insecure_channel(endpoint)
+            from .networking import GRPC_MESSAGE_OPTIONS
+
+            self._channel = grpc.insecure_channel(
+                endpoint, options=GRPC_MESSAGE_OPTIONS
+            )
 
     def launch(self, session_id: str, comp_bytes: bytes,
                arguments: dict):

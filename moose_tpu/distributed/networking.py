@@ -27,22 +27,57 @@ from ..errors import NetworkingError
 
 DEFAULT_TIMEOUT_S = 120.0
 
+# tensors routinely exceed gRPC's 4 MB default cap (an 800x800 float64 is
+# already ~5 MB on the wire); the reference raises the tonic limits the
+# same way for its SendValue payloads
+GRPC_MESSAGE_OPTIONS = (
+    ("grpc.max_send_message_length", -1),
+    ("grpc.max_receive_message_length", -1),
+)
+
 
 def transfer_key(session_id: str, rendezvous_key: str) -> str:
     return f"{session_id}/{rendezvous_key}"
 
 
-def sliced_wait(wait_slice, timeout: float, cancel, what: str) -> None:
+class ProgressClock:
+    """Monotonic liveness marker shared by a worker's ops: every local op
+    completion (and, on gRPC workers, every successful peer ping) bumps
+    it, and a blocked receive's deadline extends to ``last + timeout`` —
+    so the timeout means "no sign of progress anywhere for timeout
+    seconds", not "this one op took long" (the parallel scheduler
+    dispatches all receives at launch, so a fixed per-op deadline would
+    spuriously kill long pipelines)."""
+
+    __slots__ = ("last",)
+
+    def __init__(self):
+        import time as _time
+
+        self.last = _time.monotonic()
+
+    def bump(self):
+        import time as _time
+
+        self.last = _time.monotonic()
+
+
+def sliced_wait(wait_slice, timeout: float, cancel, what: str,
+                progress: "ProgressClock" = None) -> None:
     """Wait for ``wait_slice(seconds) -> bool`` to report arrival.
 
-    With no cancel event this is one full-length wait; with one, the wait
-    runs in <=200ms slices and a set event interrupts a blocked receive
-    promptly — checked both before and after each slice so an abort in
-    the final slice is reported as cancellation, not a spurious timeout.
-    Shared by every transport so the semantics can't drift."""
+    With no cancel event or progress clock this is one full-length wait;
+    otherwise the wait runs in <=200ms slices: a set cancel event
+    interrupts a blocked receive promptly (checked both before and after
+    each slice so an abort in the final slice is reported as
+    cancellation, not a spurious timeout), and a bumped progress clock
+    extends the deadline.  Shared by every transport so the semantics
+    can't drift."""
     import time as _time
 
-    if cancel is None:
+    from ..errors import SessionAbortedError
+
+    if cancel is None and progress is None:
         if not wait_slice(timeout):
             raise NetworkingError(
                 f"receive timed out after {timeout}s for {what!r}"
@@ -50,14 +85,17 @@ def sliced_wait(wait_slice, timeout: float, cancel, what: str) -> None:
         return
     deadline = _time.monotonic() + timeout
     while True:
-        if cancel.is_set():
-            raise NetworkingError(
+        if cancel is not None and cancel.is_set():
+            raise SessionAbortedError(
                 f"receive for {what!r} cancelled (session aborted)"
             )
+        if progress is not None:
+            deadline = max(deadline, progress.last + timeout)
         remaining = deadline - _time.monotonic()
         if remaining <= 0:
             raise NetworkingError(
-                f"receive timed out after {timeout}s for {what!r}"
+                f"receive timed out after {timeout}s (no session "
+                f"progress) for {what!r}"
             )
         if wait_slice(min(0.2, remaining)):
             return
@@ -71,6 +109,9 @@ class _CellStore:
         self._lock = threading.Lock()
         self._values: dict = {}
         self._events: dict = {}
+        # set on every arrival: lets a single receive-poller thread sleep
+        # until something (anything) lands instead of spinning
+        self.activity = threading.Event()
 
     def put(self, key: str, value):
         with self._lock:
@@ -79,13 +120,22 @@ class _CellStore:
             if ev is None:
                 ev = self._events[key] = threading.Event()
         ev.set()
+        self.activity.set()
 
-    def get(self, key: str, timeout: float, cancel=None):
+    def try_take(self, key: str):
+        """Non-blocking probe: (True, value) and consume if present."""
+        with self._lock:
+            if key in self._values:
+                self._events.pop(key, None)
+                return True, self._values.pop(key)
+        return False, None
+
+    def get(self, key: str, timeout: float, cancel=None, progress=None):
         with self._lock:
             ev = self._events.get(key)
             if ev is None:
                 ev = self._events[key] = threading.Event()
-        sliced_wait(ev.wait, timeout, cancel, key)
+        sliced_wait(ev.wait, timeout, cancel, key, progress)
         with self._lock:
             # single-consumer: drop the cell after use (sessions never
             # reuse a rendezvous key)
@@ -129,15 +179,35 @@ class LocalNetworking:
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
-                cancel=None):
+                cancel=None, progress=None):
         from ..serde import deserialize_value
 
         payload = self._store.get(
-            transfer_key(session_id, rendezvous_key), timeout, cancel
+            transfer_key(session_id, rendezvous_key), timeout, cancel,
+            progress,
         )
         if self._serialize:
             return deserialize_value(payload, plc)
         return payload
+
+    @property
+    def activity(self):
+        return self._store.activity
+
+    def try_receive(self, sender: str, rendezvous_key: str,
+                    session_id: str, plc: str = ""):
+        """Non-blocking receive probe for the worker's single poller
+        thread: (True, value) if the payload has arrived."""
+        from ..serde import deserialize_value
+
+        ok, payload = self._store.try_take(
+            transfer_key(session_id, rendezvous_key)
+        )
+        if not ok:
+            return False, None
+        if self._serialize:
+            return True, deserialize_value(payload, plc)
+        return True, payload
 
 
 class TcpNetworking:
@@ -197,7 +267,7 @@ class TcpNetworking:
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
-                cancel=None):
+                cancel=None, progress=None):
         from ..serde import deserialize_value
 
         if self._server is None:
@@ -229,14 +299,21 @@ class TcpNetworking:
                     _time.sleep(seconds - elapsed)
                 return False
 
-        sliced_wait(wait_slice, timeout, cancel, key)
+        sliced_wait(wait_slice, timeout, cancel, key, progress)
         return deserialize_value(box[0], plc)
+
+
+SEND_VALUE_METHOD = "/moose.Networking/SendValue"
+ABORT_SESSION_METHOD = "/moose.Networking/AbortSession"
+PING_METHOD = "/moose.Networking/Ping"
 
 
 class GrpcNetworking:
     """gRPC transport: a single SendValue rpc posts into the receiver's
     cell store (reference networking/grpc.rs).  The server half is hosted
-    by the worker (see distributed.worker.WorkerServer)."""
+    by the worker (see distributed.choreography.WorkerServer), which also
+    serves the participant-level AbortSession and Ping methods used by
+    the abort fanout and failure detector."""
 
     def __init__(self, identity: str, endpoints: dict, cells: Optional[
             _CellStore] = None, tls=None):
@@ -247,7 +324,7 @@ class GrpcNetworking:
         self._lock = threading.Lock()
         self._tls = tls  # distributed.tls.TlsConfig or None
 
-    def _stub(self, receiver: str):
+    def _stub(self, receiver: str, method: str = SEND_VALUE_METHOD):
         import grpc
 
         with self._lock:
@@ -263,36 +340,80 @@ class GrpcNetworking:
                     # *receiver identity* (CN = party name)
                     ch = self._tls.secure_channel(endpoint, receiver)
                 else:
-                    ch = grpc.insecure_channel(endpoint)
+                    ch = grpc.insecure_channel(
+                        endpoint, options=GRPC_MESSAGE_OPTIONS
+                    )
                 self._channels[receiver] = ch
-            return ch.unary_unary("/moose.Networking/SendValue")
+            return ch.unary_unary(method)
+
+    def ping(self, receiver: str, timeout: float = 1.0,
+             session_id: str = None) -> dict:
+        """Liveness probe against a peer's worker daemon (failure
+        detector); raises on any transport error.  With ``session_id``
+        the response carries that session's status on the peer
+        ("running" / "completed" / "aborted" / "unknown") so a live
+        PROCESS whose session already died is distinguishable from real
+        liveness — otherwise a missed abort fanout would keep extending
+        receive deadlines forever."""
+        import msgpack
+
+        payload = msgpack.packb(
+            {"from": self._identity, "session_id": session_id},
+            use_bin_type=True,
+        )
+        raw = self._stub(receiver, PING_METHOD)(payload, timeout=timeout)
+        return msgpack.unpackb(raw, raw=False) if raw else {}
+
+    def abort_session(self, receiver: str, session_id: str,
+                      reason: str, timeout: float = 3.0):
+        """Participant-level abort on a peer (first-error fanout). No
+        retry: a fanout target that is down is already failing the
+        session its own way."""
+        import msgpack
+
+        payload = msgpack.packb(
+            {
+                "session_id": session_id,
+                "reason": reason,
+                "sender": self._identity,
+            },
+            use_bin_type=True,
+        )
+        self._stub(receiver, ABORT_SESSION_METHOD)(
+            payload, timeout=timeout
+        )
+
+    def verify_sender(self, frame: dict, context) -> None:
+        """Under mTLS the claimed sender must match the peer
+        certificate's CN (reference networking/grpc.rs:150-160 rejects
+        spoofed senders); no-op without TLS."""
+        if self._tls is None:
+            return
+        from .tls import peer_common_name, reject
+
+        # fail closed: with mTLS configured, a missing context/peer
+        # identity is as unacceptable as a mismatched one
+        peer = peer_common_name(context) if context is not None else None
+        claimed = frame.get("sender")
+        if peer is None or peer != claimed:
+            reject(
+                context,
+                f"sender identity mismatch: claimed {claimed!r}, "
+                f"peer certificate CN {peer!r}",
+            )
 
     def handle_send_value(self, request: bytes, context=None,
-                          frame=None) -> bytes:
+                          frame=None, verified: bool = False) -> bytes:
         """Server-side handler: unpack (key ‖ value) frame and post it
-        (``frame`` lets a caller that already unpacked skip the repeat).
-
-        Under mTLS the claimed sender must match the peer certificate's CN
-        (reference networking/grpc.rs:150-160 rejects spoofed senders)."""
+        (``frame`` lets a caller that already unpacked skip the repeat;
+        ``verified`` skips the sender check when the caller already ran
+        :meth:`verify_sender`)."""
         import msgpack
 
         if frame is None:
             frame = msgpack.unpackb(request, raw=False)
-        if self._tls is not None:
-            from .tls import peer_common_name, reject
-
-            # fail closed: with mTLS configured, a missing context/peer
-            # identity is as unacceptable as a mismatched one
-            peer = (
-                peer_common_name(context) if context is not None else None
-            )
-            claimed = frame.get("sender")
-            if peer is None or peer != claimed:
-                reject(
-                    context,
-                    f"sender identity mismatch: claimed {claimed!r}, "
-                    f"peer certificate CN {peer!r}",
-                )
+        if not verified:
+            self.verify_sender(frame, context)
         self.cells.put(frame["key"], frame["value"])
         return b""
 
@@ -342,10 +463,27 @@ class GrpcNetworking:
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
-                cancel=None):
+                cancel=None, progress=None):
         from ..serde import deserialize_value
 
         payload = self.cells.get(
-            transfer_key(session_id, rendezvous_key), timeout, cancel
+            transfer_key(session_id, rendezvous_key), timeout, cancel,
+            progress,
         )
         return deserialize_value(payload, plc)
+
+    @property
+    def activity(self):
+        return self.cells.activity
+
+    def try_receive(self, sender: str, rendezvous_key: str,
+                    session_id: str, plc: str = ""):
+        """Non-blocking receive probe (see LocalNetworking.try_receive)."""
+        from ..serde import deserialize_value
+
+        ok, payload = self.cells.try_take(
+            transfer_key(session_id, rendezvous_key)
+        )
+        if not ok:
+            return False, None
+        return True, deserialize_value(payload, plc)

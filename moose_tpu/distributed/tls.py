@@ -63,12 +63,14 @@ class TlsConfig:
         for ``expected_identity`` (CN = party name, not hostname)."""
         import grpc
 
+        from .networking import GRPC_MESSAGE_OPTIONS
+
         return grpc.secure_channel(
             endpoint,
             self.channel_credentials(),
             options=(
                 ("grpc.ssl_target_name_override", expected_identity),
-            ),
+            ) + GRPC_MESSAGE_OPTIONS,
         )
 
 

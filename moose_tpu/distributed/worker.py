@@ -1,72 +1,96 @@
-"""Role-filtered execution of a lowered computation on one worker.
+"""Role-filtered parallel execution of a lowered computation on one worker.
 
 The distributed counterpart of the local physical executor: each worker
-walks the same global toposorted host-level graph but executes only the
-operations pinned to its own identity, exactly as the reference's
-AsyncExecutor role filter (execution/asynchronous.rs:590-605,
-execution/context.rs:60-74); Send/Receive ops hit the networking backend.
+takes the same global host-level graph, keeps only the operations pinned
+to its own identity, and executes them with dependency-counted parallelism
+— the re-design of the reference's one-async-task-per-op executor
+(execution/asynchronous.rs:453-531) for Python threads:
 
-Deadlock freedom: workers follow the global topological order (which
-includes Send->Receive rendezvous edges), sends are non-blocking and
-receives block on the cell store — for any blocked receive, the matching
-send is strictly earlier in the global order, so by induction over that
-order some worker can always make progress.
+- compute/send ops run on a bounded thread pool (jax/numpy release the
+  GIL for the heavy parts, so independent branches genuinely overlap);
+- every Receive gets its own waiter thread, so a blocked receive can
+  never occupy a compute slot.
+
+Deadlock freedom: receives don't hold pool slots, compute ops depend only
+on locally-available values, and sends are non-blocking w.r.t. the
+rendezvous (the receiver's cell store buffers out-of-order arrivals), so
+the pool always drains; for any blocked receive the matching send is on
+some peer whose own pool drains by the same argument — induction over the
+global dataflow order.
+
+Failure discipline: the FIRST exception is the root cause (reference
+join_on_first_error, execution/asynchronous.rs:27-74).  It cancels every
+in-flight and pending op of the session locally and is re-raised to the
+caller; the choreography layer then fans the abort out to peer workers.
+A ``SessionAbortedError`` (we were cancelled by someone else's root
+cause) is re-raised as-is so the caller knows not to re-fan-out.
 """
 
 from __future__ import annotations
 
+import os
 import secrets
+import threading
 import time
-from typing import Optional
+from collections import deque
+from typing import Callable, Optional
 
 import numpy as np
 
 from ..computation import Computation, HostPlacement
-from ..errors import KernelError, MissingArgumentError, StorageError
+from ..errors import (
+    KernelError,
+    MissingArgumentError,
+    SessionAbortedError,
+    StorageError,
+)
 from ..execution.physical import execute_kernel
 from ..execution.session import EagerSession
 from ..values import HostPrfKey, HostString, HostUnit
 
 
-def execute_role(
-    comp: Computation,
-    identity: str,
-    storage: dict,
-    arguments: Optional[dict],
-    networking,
-    session_id: str,
-    timeout: float = 120.0,
-    cancel=None,
-) -> dict:
-    """Execute ``identity``'s share of a lowered computation; returns
-    {"outputs": {...}, "elapsed_time_micros": int}.
+def _pool_size() -> int:
+    raw = os.environ.get("MOOSE_TPU_WORKER_THREADS")
+    if raw:
+        from ..errors import ConfigurationError
 
-    ``cancel``: optional ``threading.Event`` — checked between ops and
-    inside blocked receives (sliced waits) so an AbortComputation can
-    actually stop a running session (the reference leaves its abort
-    handler unimplemented, choreography/grpc.rs:200-205).
-    """
-    import jax.numpy as jnp
+        try:
+            n = int(raw)
+        except ValueError as e:
+            raise ConfigurationError(
+                f"MOOSE_TPU_WORKER_THREADS must be an integer >= 1, "
+                f"got {raw!r}"
+            ) from e
+        if n < 1:
+            raise ConfigurationError(
+                f"MOOSE_TPU_WORKER_THREADS must be >= 1, got {n}"
+            )
+        return n
+    # floor of 2 even on 1-core hosts: jax/numpy/serde release the GIL,
+    # so a second thread overlaps wire serialization with compute
+    return max(2, min(8, os.cpu_count() or 4))
 
-    from ..execution.interpreter import _lift_array, _to_user_value
 
-    # genuinely-distributed parties must not derive share masks from the
-    # non-cryptographic default PRF (ADVICE r1; the client runtime guards
-    # too, but workers execute whatever arrives)
-    from ..dialects.ring import require_strong_prf
+class _AnyEvent:
+    """is_set() over several events — lets a receive slice on both the
+    external abort (choreographer/peer) and the local first-error."""
 
-    require_strong_prf("distributed worker")
+    def __init__(self, *events):
+        self._events = [e for e in events if e is not None]
 
-    t0 = time.perf_counter()
-    arguments = arguments or {}
+    def is_set(self) -> bool:
+        return any(e.is_set() for e in self._events)
+
+
+def validate_deployable(comp: Computation) -> None:
+    """Reject graphs that would fail opaquely mid-run: composite
+    placements (lowering skipped) and raw cross-host edges (networking
+    pass skipped)."""
     composite = [
         plc.name for plc in comp.placements.values()
         if not isinstance(plc, HostPlacement)
     ]
     if composite:
-        # a logical graph would silently skip every replicated op (no
-        # worker owns the composite placement) and fail later with an
-        # opaque missing-operand error
         raise KernelError(
             "worker received an uncompiled computation (composite "
             f"placements {composite}); compile it first — e.g. "
@@ -81,25 +105,67 @@ def execute_role(
                 comp.placement_of(src).name != plc_name
                 and op.kind != "Receive"
             ):
-                # cross-host edge with no Send/Receive stitched in — the
-                # networking pass was skipped
                 raise KernelError(
                     f"op {op.name} on {plc_name} reads {inp} from "
                     f"{comp.placement_of(src).name} without a "
                     "Send/Receive pair; run the `networking` compiler "
                     "pass before deploying"
                 )
+
+
+def execute_role(
+    comp: Computation,
+    identity: str,
+    storage: dict,
+    arguments: Optional[dict],
+    networking,
+    session_id: str,
+    timeout: float = 120.0,
+    cancel=None,
+    max_workers: Optional[int] = None,
+    progress=None,
+) -> dict:
+    """Execute ``identity``'s share of a lowered computation; returns
+    {"outputs": {...}, "elapsed_time_micros": int}.
+
+    ``cancel``: optional ``threading.Event`` — a set event (choreographer
+    abort or peer-failure fanout) stops pending ops and interrupts
+    blocked receives promptly; the run raises ``SessionAbortedError``.
+
+    ``progress``: optional :class:`~.networking.ProgressClock`.  Receives
+    time out ``timeout`` seconds after the LAST progress (local op
+    completion, or whatever else the caller bumps it on — the gRPC
+    worker bumps it on successful peer pings), not after dispatch: the
+    parallel scheduler starts every receive waiter up front, so a fixed
+    deadline would kill any pipeline whose upstream takes longer than
+    ``timeout`` to produce.
+    """
+    import jax.numpy as jnp
+
+    from ..execution.interpreter import _lift_array, _to_user_value
+
+    # genuinely-distributed parties must not derive share masks from the
+    # non-cryptographic default PRF (ADVICE r1; the client runtime guards
+    # too, but workers execute whatever arrives)
+    from ..dialects.ring import require_strong_prf
+
+    require_strong_prf("distributed worker")
+
+    from .networking import ProgressClock
+
+    t0 = time.perf_counter()
+    arguments = arguments or {}
+    validate_deployable(comp)
+    if progress is None:
+        progress = ProgressClock()
+
     sess = EagerSession(session_id=session_id)
     env: dict = {}
     outputs: dict = {}
 
-    for name in comp.toposort_names():
-        if cancel is not None and cancel.is_set():
-            raise KernelError(f"session {session_id} aborted")
-        op = comp.operations[name]
-        plc = comp.placement_of(op)
-        if plc.name != identity:
-            continue
+    def exec_one(op):
+        """Run one op to a value; called off-thread, must not touch
+        scheduler state."""
         kind = op.kind
         if kind == "Send":
             networking.send(
@@ -108,36 +174,32 @@ def execute_role(
                 op.attributes["rendezvous_key"],
                 session_id,
             )
-            env[name] = HostUnit(identity)
-            continue
+            return HostUnit(identity)
         if kind == "Receive":
-            env[name] = networking.receive(
+            return networking.receive(
                 op.attributes["sender"],
                 op.attributes["rendezvous_key"],
                 session_id,
                 plc=identity,
                 timeout=timeout,
-                cancel=cancel,
+                cancel=abort_any,
+                progress=progress,
             )
-            continue
         if kind == "PrfKeyGen":
             # each party generates its own key from local entropy — this
             # is where the distributed deployment gets real inter-party
             # security, unlike the single-trust-domain local runtime
             words = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
-            env[name] = HostPrfKey(jnp.asarray(words), identity)
-            continue
+            return HostPrfKey(jnp.asarray(words), identity)
         if kind == "Input":
-            val = arguments.get(name)
+            val = arguments.get(op.name)
             if val is None:
                 raise MissingArgumentError(
-                    f"missing argument {name!r} on {identity}"
+                    f"missing argument {op.name!r} on {identity}"
                 )
             if isinstance(val, str):
-                env[name] = HostString(val, identity)
-            else:
-                env[name] = _lift_array(np.asarray(val), op, identity)
-            continue
+                return HostString(val, identity)
+            return _lift_array(np.asarray(val), op, identity)
         if kind == "Load":
             key_val = env[op.inputs[0]]
             key = (
@@ -157,25 +219,209 @@ def execute_role(
                 raw = storage.load(key, query)
             else:
                 raw = storage[key]
-            env[name] = _lift_array(np.asarray(raw), op, identity)
-            continue
+            return _lift_array(np.asarray(raw), op, identity)
         if kind == "Save":
             key = env[op.inputs[0]]
             if not isinstance(key, HostString):
                 raise KernelError(
-                    f"Save {name}: key must be a string, found "
+                    f"Save {op.name}: key must be a string, found "
                     f"{type(key).__name__}"
                 )
             storage[key.value] = _to_user_value(env[op.inputs[1]])
-            env[name] = HostUnit(identity)
-            continue
+            return HostUnit(identity)
         if kind == "Output":
             value = env[op.inputs[0]]
-            env[name] = value
-            outputs[name] = _to_user_value(value)
-            continue
+            outputs[op.name] = _to_user_value(value)
+            return value
         args = [env[i] for i in op.inputs]
-        env[name] = execute_kernel(sess, op, identity, args)
+        return execute_kernel(sess, op, identity, args)
+
+    # ---- dependency-counted scheduler --------------------------------
+    mine = [
+        comp.operations[name]
+        for name in comp.toposort_names()
+        if comp.placement_of(comp.operations[name]).name == identity
+    ]
+    local_abort = threading.Event()
+    abort_any = _AnyEvent(cancel, local_abort)
+
+    if not mine:
+        return {"outputs": {}, "elapsed_time_micros": 0}
+
+    pending: dict = {}
+    dependents: dict = {name: [] for name in (op.name for op in mine)}
+    for op in mine:
+        if op.kind == "Receive":
+            # a Receive's inputs live on the sender's host; the value
+            # arrives through the rendezvous store, not the local env
+            local = []
+        else:
+            local = [i for i in op.inputs if i in dependents]
+        pending[op.name] = len(local)
+        for i in local:
+            dependents[i].append(op.name)
+    by_name = {op.name: op for op in mine}
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    lock = threading.Lock()
+    done = threading.Event()
+    remaining = [len(mine)]
+    failure: list = []  # [exception] — first error wins
+
+    def fail(exc: BaseException) -> None:
+        with lock:
+            if not failure:
+                failure.append(exc)
+        local_abort.set()
+        done.set()
+
+    n_compute = max_workers or _pool_size()
+    pool = ThreadPoolExecutor(
+        max_workers=n_compute,
+        thread_name_prefix=f"moose-{identity}",
+    )
+
+    def finish(name: str, ready_sink: Callable[[object], None]) -> None:
+        progress.bump()
+        newly_ready = []
+        with lock:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                done.set()
+            for dep in dependents[name]:
+                pending[dep] -= 1
+                if pending[dep] == 0:
+                    newly_ready.append(by_name[dep])
+        for op in newly_ready:
+            ready_sink(op)
+
+    # Receives: one POLLER thread probes every outstanding rendezvous via
+    # the transport's non-blocking try_receive — thousands of receives
+    # cost one thread, not one each (deadlock-free: receives never hold
+    # compute slots, and the poller itself never blocks on any single
+    # key).  Transports without try_receive (raw TCP) fall back to a
+    # waiter thread per receive.
+    pollable = hasattr(networking, "try_receive")
+    recv_lock = threading.Lock()
+    outstanding: dict = {}  # op name -> op, receives awaiting payload
+
+    def poll_receives() -> None:
+        activity = getattr(networking, "activity", None)
+        while not abort_any.is_set():
+            if activity is not None:
+                activity.clear()
+            with recv_lock:
+                items = list(outstanding.items())
+            if not items:
+                if done.is_set():
+                    return
+            arrived = []
+            for name, op in items:
+                try:
+                    ok, val = networking.try_receive(
+                        op.attributes["sender"],
+                        op.attributes["rendezvous_key"],
+                        session_id,
+                        plc=identity,
+                    )
+                except BaseException as e:  # noqa: BLE001 — root cause
+                    fail(e)
+                    return
+                if ok:
+                    env[name] = val
+                    with recv_lock:
+                        outstanding.pop(name, None)
+                    arrived.append(name)
+            for name in arrived:
+                finish(name, dispatch)
+            if items and not arrived and (
+                time.monotonic() > progress.last + timeout
+            ):
+                from ..errors import NetworkingError
+
+                keys = sorted(
+                    op.attributes["rendezvous_key"] for _, op in items
+                )[:4]
+                fail(NetworkingError(
+                    f"receive timed out after {timeout}s of no session "
+                    f"progress; {len(items)} pending (first keys "
+                    f"{keys})"
+                ))
+                return
+            if activity is not None:
+                activity.wait(0.1)
+            else:
+                time.sleep(0.005)
+
+    def dispatch(op) -> None:
+        if abort_any.is_set():
+            return  # the main wait loop polls the abort, not `done`
+        if op.kind == "Receive":
+            if pollable:
+                with recv_lock:
+                    outstanding[op.name] = op
+                activity = getattr(networking, "activity", None)
+                if activity is not None:
+                    activity.set()  # wake the poller for the new key
+            else:
+                # dedicated waiter thread: blocked receives must never
+                # occupy compute slots (deadlock-freedom invariant)
+                threading.Thread(
+                    target=run_op, args=(op,), daemon=True,
+                    name=f"moose-{identity}-recv-{op.name}",
+                ).start()
+        else:
+            try:
+                pool.submit(run_op, op)
+            except RuntimeError:
+                # raced an abort-triggered pool shutdown; the abort
+                # outcome is already decided, just stop feeding it
+                if not abort_any.is_set():
+                    raise
+
+    def run_op(op) -> None:
+        try:
+            env[op.name] = exec_one(op)
+        except BaseException as e:  # noqa: BLE001 — root cause capture
+            fail(e)
+            return
+        finish(op.name, dispatch)
+
+    initial = [op for op in mine if pending[op.name] == 0]
+    has_receives = any(op.kind == "Receive" for op in mine)
+    poller = None
+    try:
+        for op in initial:
+            dispatch(op)
+        if pollable and has_receives:
+            poller = threading.Thread(
+                target=poll_receives, daemon=True,
+                name=f"moose-{identity}-recv-poller",
+            )
+            poller.start()
+        # `done` fires on completion or local failure; an external abort
+        # (choreographer / peer fanout) only sets its event, so poll it —
+        # in-flight receives unwind via their own sliced waits
+        while not done.wait(0.1):
+            if abort_any.is_set():
+                break
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+    if failure:
+        exc = failure[0]
+        if cancel is not None and cancel.is_set() and not isinstance(
+            exc, SessionAbortedError
+        ):
+            # the external abort raced our own error path: report it as
+            # an abort so the caller doesn't re-fan-out
+            raise SessionAbortedError(
+                f"session {session_id} aborted"
+            ) from exc
+        raise exc
+    if cancel is not None and cancel.is_set():
+        raise SessionAbortedError(f"session {session_id} aborted")
 
     elapsed = int((time.perf_counter() - t0) * 1e6)
     return {"outputs": outputs, "elapsed_time_micros": elapsed}

@@ -50,6 +50,13 @@ class SessionAlreadyExistsError(MooseError):
     Error::SessionAlreadyExists, execution/asynchronous.rs:571-576)."""
 
 
+class SessionAbortedError(MooseError):
+    """A session was cancelled (choreographer abort, peer abort fanout, or
+    failure-detector trip) rather than failing on its own work.  Receivers
+    of this error must NOT re-fan-out an abort: the initiator already did
+    (reference root-cause discipline, execution/asynchronous.rs:27-74)."""
+
+
 class UnimplementedError(MooseError, NotImplementedError):
     """Operator/placement combination not supported (reference
     Error::UnimplementedOperator)."""

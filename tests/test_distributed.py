@@ -494,7 +494,7 @@ def test_abort_cancels_running_session():
     import msgpack
 
     from moose_tpu.distributed.choreography import WorkerServer
-    from moose_tpu.errors import KernelError
+    from moose_tpu.errors import SessionAbortedError
     from moose_tpu.serde import serialize_computation
 
     # cooperative cancel at the worker level: a pre-set event aborts
@@ -506,7 +506,7 @@ def test_abort_cancels_running_session():
     )
     ev = threading.Event()
     ev.set()
-    with pytest.raises(KernelError, match="aborted"):
+    with pytest.raises(SessionAbortedError, match="aborted"):
         execute_role(
             compiled, "alice", {}, {"x": x, "w": x[:, :1]},
             LocalNetworking(), "s-abort", cancel=ev,
@@ -540,3 +540,226 @@ def test_abort_cancels_running_session():
         assert _t.monotonic() - t0 < 5.0
     finally:
         srv.stop()
+
+
+def _start_cluster(identities, **kwargs):
+    """In-process WorkerServers on free ports with a shared endpoint
+    table; returns (servers, endpoints)."""
+    from moose_tpu.distributed.choreography import WorkerServer
+
+    servers, endpoints = {}, {}
+    for i in identities:
+        srv = WorkerServer(i, 0, {}, **kwargs).start()
+        servers[i] = srv
+        endpoints[i] = f"127.0.0.1:{srv.port}"
+    for srv in servers.values():
+        srv.endpoints.update(endpoints)
+        srv.networking._endpoints.update(endpoints)
+    return servers, endpoints
+
+
+def test_worker_error_fans_out_abort_to_peers():
+    """First root-cause error on one worker aborts the session on every
+    peer fast — the cross-worker extension of the reference's
+    join_on_first_error (execution/asynchronous.rs:27-74): peers must
+    not sit in blocked receives until the cell-store timeout."""
+    import time
+
+    import msgpack
+
+    from moose_tpu.serde import serialize_computation, serialize_value
+
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+        b: pm.Argument(placement=carole, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64) + b
+        return out
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2, 3))
+    w = rng.normal(size=(3, 1))
+    b = rng.normal(size=(2, 1))
+    all_args = {"x": x, "w": w, "b": b}
+    compiled = compile_computation(
+        tracer.trace(comp), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(all_args),
+    )
+    blob = serialize_computation(compiled)
+
+    servers, _ = _start_cluster(["alice", "bob", "carole"])
+    try:
+        # launch everywhere but WITHOUT carole's argument: her Input op
+        # raises immediately — the root cause that must fan out
+        sent = {
+            k: serialize_value(v) for k, v in all_args.items() if k != "b"
+        }
+        for srv in servers.values():
+            srv._launch_inner(msgpack.packb(
+                {"session_id": "fo-1", "computation": blob,
+                 "arguments": sent},
+                use_bin_type=True,
+            ))
+        t0 = time.monotonic()
+        results = {
+            name: msgpack.unpackb(
+                srv._results.get("fo-1", timeout=10.0), raw=False
+            )
+            for name, srv in servers.items()
+        }
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"abort fanout took {elapsed:.1f}s"
+        assert "missing argument" in results["carole"]["error"]
+        for peer in ("alice", "bob"):
+            assert "aborted by carole" in results[peer]["error"], results
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_dead_peer_trips_failure_detector():
+    """A worker that is unreachable while a session runs fails the
+    session on the live workers within the detector budget — a killed
+    party must not leave the others blocked until the receive timeout."""
+    import time
+
+    import msgpack
+
+    from moose_tpu.serde import serialize_computation, serialize_value
+
+    x = np.ones((2, 2))
+    w = x[:, :1]
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments({"x": x, "w": w}),
+    )
+    blob = serialize_computation(compiled)
+
+    fd = dict(ping_interval=0.25, ping_misses=3, startup_grace=1.5)
+    servers, endpoints = _start_cluster(["alice", "bob"], **fd)
+    try:
+        # carole is dead from the start: a reserved port nothing listens on
+        for srv in servers.values():
+            srv.endpoints["carole"] = "127.0.0.1:9"
+            srv.networking._endpoints["carole"] = "127.0.0.1:9"
+        args = {"x": serialize_value(x), "w": serialize_value(w)}
+        t0 = time.monotonic()
+        for srv in servers.values():
+            srv._launch_inner(msgpack.packb(
+                {"session_id": "fd-1", "computation": blob,
+                 "arguments": args},
+                use_bin_type=True,
+            ))
+        results = {
+            name: msgpack.unpackb(
+                srv._results.get("fd-1", timeout=15.0), raw=False
+            )
+            for name, srv in servers.items()
+        }
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"failure detection took {elapsed:.1f}s"
+        for name, result in results.items():
+            assert "error" in result, (name, result)
+            assert (
+                "unreachable" in result["error"]
+                or "aborted by" in result["error"]
+                or "aborted on peer" in result["error"]
+            ), (name, result)
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+@pytest.mark.slow
+def test_sigkilled_comet_worker_fails_session_everywhere(tmp_path):
+    """The done-criterion for distributed failure handling: SIGKILL a
+    real comet worker PROCESS mid-session; the surviving workers' failure
+    detectors must fail the session in well under the receive timeout."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from moose_tpu.distributed.choreography import ChoreographyClient
+    from moose_tpu.serde import serialize_computation
+
+    base = 21700
+    endpoints = {
+        "alice": f"127.0.0.1:{base}",
+        "bob": f"127.0.0.1:{base + 1}",
+        "carole": f"127.0.0.1:{base + 2}",
+    }
+    ep_spec = ",".join(f"{k}={v}" for k, v in endpoints.items())
+    env = _cpu_subprocess_env()
+    procs = {
+        name: subprocess.Popen(
+            [sys.executable, "-m", "moose_tpu.bin.comet",
+             "--identity", name, "--port", str(base + i),
+             "--endpoints", ep_spec],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        )
+        for i, name in enumerate(endpoints)
+    }
+    try:
+        import grpc
+
+        deadline = time.time() + 60
+        for ep in endpoints.values():
+            while True:
+                try:
+                    grpc.channel_ready_future(
+                        grpc.insecure_channel(ep)
+                    ).result(timeout=5)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+        # big enough that the session is still in flight when the kill
+        # lands (u128 ring matmul on CPU takes seconds at this size)
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(800, 800))
+        w = rng.normal(size=(800, 2))
+        args = {"x": x, "w": w}
+        compiled = compile_computation(
+            tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+            arg_specs=arg_specs_from_arguments(args),
+        )
+        blob = serialize_computation(compiled)
+        clients = {
+            name: ChoreographyClient(ep) for name, ep in endpoints.items()
+        }
+        for client in clients.values():
+            resp = client.launch("kill-1", blob, args)
+            assert resp.get("ok")
+        procs["carole"].send_signal(signal.SIGKILL)
+        t0 = time.monotonic()
+        result = clients["alice"].retrieve("kill-1", timeout=30.0)
+        elapsed = time.monotonic() - t0
+        assert "error" in result, result
+        assert elapsed < 10.0, f"failure took {elapsed:.1f}s to surface"
+        assert (
+            "unreachable" in result["error"]
+            or "aborted by" in result["error"]
+        ), result
+    finally:
+        for p in procs.values():
+            try:
+                p.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
