@@ -3,8 +3,14 @@ the 3 compute parties decrypt *under MPC* (the plaintext never exists on
 any single machine) and score an ONNX model (reference AesWrapper,
 pymoose/pymoose/predictors/predictor.py:49-85).
 
-  python examples/aes_inference.py
+  python examples/aes_inference.py          # fused local simulation
+  python examples/aes_inference.py --grpc   # 3 real worker processes:
+      # the ciphertext lowers through the compile pipeline and the AES
+      # circuit executes role-filtered over gRPC (slow: the decrypt
+      # circuit is ~200k host ops walked eagerly per worker)
 """
+
+import sys
 
 import numpy as np
 
@@ -40,23 +46,53 @@ def secure_score(
 
 def main():
     rng = np.random.default_rng(1)
-    features = rng.normal(size=(2, 4))
-    w = rng.normal(size=(4, 1))
+    grpc_mode = "--grpc" in sys.argv
+    shape = (1, 2) if grpc_mode else (2, 4)
+    features = rng.normal(size=shape)
+    w = rng.normal(size=(shape[1], 1))
 
     # the data owner encrypts client-side with any AES-GCM implementation
     key = bytes(range(16))
     nonce = bytes([7] * 12)
     wire = aes.encrypt_fixed_array(key, nonce, features, frac_precision=23)
+    arguments = {
+        "aes_data": np.asarray(wire),
+        "aes_key": np.asarray(aes.bytes_to_bits_be(key)),
+        "w": w,
+    }
 
-    runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=False)
-    (scores,) = runtime.evaluate_computation(
-        secure_score,
-        arguments={
-            "aes_data": wire,
-            "aes_key": aes.bytes_to_bits_be(key),
-            "w": w,
-        },
-    ).values()
+    if grpc_mode:
+        import os
+        import pathlib
+
+        sys.path.insert(0, str(
+            pathlib.Path(__file__).resolve().parent.parent / "benchmarks"
+        ))
+        os.environ.setdefault("MOOSE_TPU_PRF", "threefry")
+        from moose_tpu.dialects import ring
+
+        ring.set_prf_impl("threefry")  # real share masks between workers
+        from distributed_grpc import _teardown, spawn_workers
+
+        from moose_tpu.runtime import GrpcMooseRuntime
+
+        procs, endpoints = spawn_workers(base_port=22500)
+        try:
+            runtime = GrpcMooseRuntime(endpoints)
+            outputs, timings = runtime.evaluate_computation(
+                secure_score, arguments, timeout=900.0
+            )
+            (scores,) = outputs.values()
+            print("per-role micros:", timings)
+        finally:
+            _teardown(procs)
+    else:
+        runtime = LocalMooseRuntime(
+            ["alice", "bob", "carole"], use_jit=False
+        )
+        (scores,) = runtime.evaluate_computation(
+            secure_score, arguments
+        ).values()
     plain = 1 / (1 + np.exp(-(features @ w)))
     print("secure scores:   ", np.ravel(scores))
     print("plaintext scores:", np.ravel(plain))

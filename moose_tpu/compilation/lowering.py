@@ -80,6 +80,40 @@ def arg_specs_from_arguments(arguments: dict, storage=None, comp=None):
     return specs
 
 
+def _aes_bit_len(ret_name: str) -> int:
+    # AesTensor = 96 nonce + 128 ciphertext bits; keys are 128 bits
+    return 224 if ret_name == "AesTensor" else 128
+
+
+def _lift_aes_boundary(sess, comp, op, plc, bits_value, owner: str):
+    """Wrap a lowered HostBitTensor boundary value (leading axis = bit
+    index) as the AES structure the Decrypt kernels consume — the
+    symbolic mirror of ``aes.lift_input`` (the eager boundary), so
+    encrypted inputs survive the explicit lowering pipeline and deploy
+    to real workers (reference lowers Decrypt like any op,
+    encrypted/mod.rs:14-40)."""
+    from ..dialects import replicated as rep_ops
+    from ..values import AesTensor, HostAesKey, RepAesKey, RepBitArray
+
+    ret = op.signature.return_type
+    if ret.name == "AesTensor":
+        nonce = sess.strided_slice(owner, bits_value, (slice(0, 96),))
+        cipher = sess.strided_slice(owner, bits_value, (slice(96, 224),))
+        return AesTensor(nonce, cipher, owner)
+    if ret.name in ("AesKey", "HostAesKey", "ReplicatedAesKey"):
+        if plc.kind == "Host":
+            return HostAesKey(bits_value, owner)
+        if plc.kind == "Replicated":
+            # cleartext key bits arrive on the first owner and are
+            # secret-shared from there, matching aes.lift_input
+            shared = rep_ops.share(sess, plc, bits_value)
+            return RepAesKey(RepBitArray(shared, 128))
+    raise CompilationError(
+        f"op {op.name}: cannot lower AES boundary of type {ret.name} "
+        f"on {plc.kind} placement"
+    )
+
+
 def _lift_boundary(sess, op, plc_name: str, shape, np_dtype):
     """Emit a host-level boundary op (Input/Load) and wrap its result as a
     symbolic runtime value."""
@@ -133,12 +167,34 @@ def lower(comp: Computation, arg_specs: Optional[dict] = None) -> Computation:
 
         if kind == "Input":
             if op.signature.return_type.name in AES_TY_NAMES:
-                raise CompilationError(
-                    f"op {name}: AES-typed inputs are not supported by "
-                    "the explicit lowering pipeline yet; evaluate without "
-                    "compiler_passes (the default fused path decrypts "
-                    "under MPC)"
+                spec = arg_specs.get(name)
+                if spec is None:
+                    raise MissingArgumentError(
+                        f"lowering requires a shape spec for AES input "
+                        f"{name!r}; pass arg_specs"
+                    )
+                shape, _np_dtype = spec
+                want = _aes_bit_len(op.signature.return_type.name)
+                if not shape or shape[0] != want:
+                    raise CompilationError(
+                        f"AES input {name}: leading axis must be {want} "
+                        f"bits, found shape {shape}"
+                    )
+                owner = (
+                    plc.name
+                    if isinstance(plc, HostPlacement)
+                    else plc.owners[0]
                 )
+                in_name = sess.add_operation(
+                    "Input", [], owner,
+                    Signature((), Ty("HostBitTensor", dt.bool_)),
+                    dict(op.attributes), name=name,
+                )
+                bits = HostBitTensor(SymArray(in_name, shape), owner)
+                env[name] = _lift_aes_boundary(
+                    sess, comp, op, plc, bits, owner
+                )
+                continue
             spec = arg_specs.get(name)
             if spec is None:
                 raise MissingArgumentError(
@@ -161,12 +217,35 @@ def lower(comp: Computation, arg_specs: Optional[dict] = None) -> Computation:
 
         if kind == "Load":
             if op.signature.return_type.name in AES_TY_NAMES:
-                raise CompilationError(
-                    f"op {name}: AES-typed Loads are not supported by the "
-                    "explicit lowering pipeline yet; evaluate without "
-                    "compiler_passes (the default fused path decrypts "
-                    "under MPC)"
+                spec = arg_specs.get(name)
+                if spec is None:
+                    raise MissingArgumentError(
+                        f"lowering requires a shape spec for AES Load "
+                        f"{name!r}; pass arg_specs"
+                    )
+                shape, _np_dtype = spec
+                want = _aes_bit_len(op.signature.return_type.name)
+                if not shape or shape[0] != want:
+                    raise CompilationError(
+                        f"AES Load {name}: leading axis must be {want} "
+                        f"bits, found shape {shape}"
+                    )
+                owner = (
+                    plc.name
+                    if isinstance(plc, HostPlacement)
+                    else plc.owners[0]
                 )
+                key_in = sess._name_of(env[op.inputs[0]])
+                load_name = sess.add_operation(
+                    "Load", [key_in], owner,
+                    Signature((_STRING_TY,), Ty("HostBitTensor", dt.bool_)),
+                    dict(op.attributes), name=name,
+                )
+                bits = HostBitTensor(SymArray(load_name, shape), owner)
+                env[name] = _lift_aes_boundary(
+                    sess, comp, op, plc, bits, owner
+                )
+                continue
             spec = arg_specs.get(name)
             if spec is None:
                 raise MissingArgumentError(

@@ -206,7 +206,27 @@ def max_pool2d(sess, rep, x: RepFixedTensor, pool, strides=None,
     rounds of secure compare+mux; expensive — ResNet uses it once)."""
     ph, pw = pool
     strides = tuple(strides) if strides is not None else (ph, pw)
-    c = x.tensor.shares[0][0].shape[3]
+    _n, h, w, c = x.tensor.shares[0][0].shape
+    from . import ring as _ring
+
+    (p0, p1), (q0, q1) = _ring.resolve_padding(
+        padding, h, w, ph, pw, *strides
+    )
+    if (p0, p1, q0, q1) != (0, 0, 0, 0):
+        import os
+
+        if os.environ.get("MOOSE_TPU_MAXPOOL_ZERO_PAD") != "1":
+            from ..errors import KernelError
+
+            raise KernelError(
+                "padded max_pool2d on a replicated placement pads with "
+                "the ring encoding of 0, while the host kernel pads "
+                "with -inf — negative inputs would silently produce "
+                "different results per placement.  Use VALID padding, "
+                "pad on the host side, or set "
+                "MOOSE_TPU_MAXPOOL_ZERO_PAD=1 to accept zero-padding "
+                "semantics."
+            )
     patches = rep_ops.im2col(sess, rep, x.tensor, ph, pw, strides, padding)
     taps = ph * pw
     shp = patches.shares[0][0].shape
@@ -324,9 +344,15 @@ def top_most_index(sess, rep, x: RepTensor, max_bits: int) -> RepTensor:
     return rep_ops.weighted_bit_sum(sess, rep, z_ring, weights, width)
 
 
-def norm(sess, rep, x: RepTensor, max_bits: int):
+def norm(sess, rep, x: RepTensor, max_bits: int, positive: bool = False):
     """(|x| upshifted to put its top bit at max_bits-1, signed scale factor)
-    (division.rs:107-139)."""
+    (division.rs:107-139).  ``positive=True`` skips the msb/sign round
+    entirely — a caller that KNOWS x > 0 (softmax's sum of positive
+    exponentials, sigmoid's 1 + e^x) saves a full secure comparison."""
+    if positive:
+        top = top_most_index(sess, rep, x, max_bits)
+        upshifted = rep_ops.mul(sess, rep, x, top)
+        return upshifted, top
     m = rep_ops.msb(sess, rep, x)
     m_ring = rep_ops.b2a(sess, rep, m, _width_of(x))
     sign = sign_from_msb(sess, rep, m_ring)
@@ -338,12 +364,13 @@ def norm(sess, rep, x: RepTensor, max_bits: int):
 
 
 def approximate_reciprocal(
-    sess, rep, x: RepTensor, int_precision: int, frac_precision: int
+    sess, rep, x: RepTensor, int_precision: int, frac_precision: int,
+    positive: bool = False,
 ) -> RepTensor:
     """Initial w ~ 1/x for Goldschmidt (division.rs:200-248):
     w = (2.9142 - 2*norm(x)) * signed_topmost, truncated by 2*int."""
     total = int_precision + frac_precision
-    upshifted, signed_top = norm(sess, rep, x, total)
+    upshifted, signed_top = norm(sess, rep, x, total, positive=positive)
     alpha_raw = encode_const(2.9142, total, _width_of(x))
     d = public_sub_raw(
         sess, rep, alpha_raw, rep_ops.shl(sess, rep, upshifted, 1)
@@ -352,7 +379,8 @@ def approximate_reciprocal(
     return rep_ops.trunc_pr(sess, rep, w, 2 * int_precision)
 
 
-def div(sess, rep, x: RepFixedTensor, y: RepFixedTensor) -> RepFixedTensor:
+def div(sess, rep, x: RepFixedTensor, y: RepFixedTensor,
+        positive_divisor: bool = False) -> RepFixedTensor:
     """Goldschmidt division (division.rs:20-98), with a rescale-early
     refinement: the reference keeps the residual ``a`` at scale 2f, so the
     ``a*a`` step needs 4f raw bits and silently wraps for f=40 on ring128
@@ -373,7 +401,9 @@ def div(sess, rep, x: RepFixedTensor, y: RepFixedTensor) -> RepFixedTensor:
         )
     theta = max(1, math.ceil(math.log2(k / math.log2(17.0))))
 
-    w = approximate_reciprocal(sess, rep, y.tensor, i_p, f_p)
+    w = approximate_reciprocal(
+        sess, rep, y.tensor, i_p, f_p, positive=positive_divisor
+    )
     alpha_raw = encode_const(1.0, f_p, width)
 
     init_prod = rep_ops.trunc_pr(
@@ -623,6 +653,7 @@ def sigmoid(sess, rep, x: RepFixedTensor) -> RepFixedTensor:
         rep,
         RepFixedTensor(num, i_p, f_p),
         RepFixedTensor(den, i_p, f_p),
+        positive_divisor=True,
     )
 
 
@@ -782,4 +813,4 @@ def softmax(
     total_e = RepFixedTensor(
         rep_ops.expand_dims(sess, rep, total.tensor, axis=axis), i_p, f_p
     )
-    return div(sess, rep, normalized, total_e)
+    return div(sess, rep, normalized, total_e, positive_divisor=True)

@@ -139,14 +139,7 @@ class WorkerServer:
 
         msg = _unpack(request)
         session_id = msg["session_id"]
-        comp = deserialize_computation(msg["computation"])
-        peers = [
-            plc.name for plc in comp.placements.values()
-            if isinstance(plc, HostPlacement)
-            and plc.name != self.identity
-            and plc.name in self.endpoints
-        ]
-        state = _SessionState(peers)
+        state = _SessionState([])
         with self._lock:
             if session_id in self._aborted:
                 # abort raced ahead of launch (gRPC retry/reordering):
@@ -157,16 +150,33 @@ class WorkerServer:
             if session_id in self._sessions or session_id in self._completed:
                 raise SessionAlreadyExistsError(session_id)
             self._sessions[session_id] = state
-        arguments = {
-            name: deserialize_value(blob)
-            for name, blob in (msg.get("arguments") or {}).items()
-        }
 
         def run():
             from .worker import execute_role
 
             fanout_reason = None
             try:
+                # deserialization happens off the rpc thread: a large
+                # lowered graph (an AES decrypt circuit is ~200k ops)
+                # would otherwise hold the launch rpc past its deadline
+                comp = deserialize_computation(msg["computation"])
+                state.peers.extend(
+                    plc.name for plc in comp.placements.values()
+                    if isinstance(plc, HostPlacement)
+                    and plc.name != self.identity
+                    and plc.name in self.endpoints
+                )
+                if state.peers and self.ping_interval > 0:
+                    threading.Thread(
+                        target=self._failure_detector,
+                        args=(session_id, state),
+                        daemon=True,
+                        name=f"moose-fd-{session_id[:8]}",
+                    ).start()
+                arguments = {
+                    name: deserialize_value(blob)
+                    for name, blob in (msg.get("arguments") or {}).items()
+                }
                 result = execute_role(
                     comp, self.identity, self.storage, arguments,
                     self.networking, session_id, cancel=state.cancel,
@@ -197,20 +207,28 @@ class WorkerServer:
                 self._sessions.pop(session_id, None)
                 if session_id not in self._aborted:
                     self._results.put(session_id, payload)
-                    self._completed.append(session_id)
-                    while len(self._completed) > self._MAX_ABORTED:
-                        self._completed.popleft()
+                    if fanout_reason is None:
+                        self._completed.append(session_id)
+                        while len(self._completed) > self._MAX_ABORTED:
+                            self._completed.popleft()
+                    else:
+                        # a root-cause failure is remembered as ABORTED,
+                        # not completed: peers' pings then adopt the
+                        # abort even if the fanout below never lands
+                        # (the result cell above keeps the real error
+                        # for the retriever)
+                        self._aborted.append(session_id)
+                        while len(self._aborted) > self._MAX_ABORTED:
+                            self._aborted.popleft()
             if fanout_reason is not None:
-                self._fanout_abort(session_id, fanout_reason, state.peers)
+                # peers may be unknown if the failure hit before the
+                # graph deserialized — notify every configured endpoint
+                targets = state.peers or [
+                    p for p in self.endpoints if p != self.identity
+                ]
+                self._fanout_abort(session_id, fanout_reason, targets)
 
         threading.Thread(target=run, daemon=True).start()
-        if peers and self.ping_interval > 0:
-            threading.Thread(
-                target=self._failure_detector,
-                args=(session_id, state),
-                daemon=True,
-                name=f"moose-fd-{session_id[:8]}",
-            ).start()
         return _pack({"ok": True})
 
     def _retrieve(self, request: bytes, context=None) -> bytes:
@@ -357,6 +375,12 @@ class WorkerServer:
             with self._lock:
                 if session_id not in self._sessions:
                     return  # session finished or was aborted
+            # progress extends blocked receives only when EVERY peer
+            # shows session liveness this round: a single peer stuck at
+            # "unknown" (its launch never arrived — e.g. the client died
+            # mid-fanout) must let the hard timeout fire even while the
+            # other peers keep answering
+            all_live = True
             for peer in state.peers:
                 if state.cancel.is_set():
                     return
@@ -377,13 +401,10 @@ class WorkerServer:
                         )
                         self._abort_local(session_id, reason=reason)
                         return
-                    if peer_session in ("running", "completed"):
-                        # genuine liveness for OUR session: extend
-                        # blocked receives.  "unknown" (launch not yet
-                        # arrived, or state aged out) deliberately does
-                        # not extend — the hard timeout backstop stays
-                        state.progress.bump()
+                    if peer_session not in ("running", "completed"):
+                        all_live = False
                 except Exception as e:  # noqa: BLE001 — rpc failure
+                    all_live = False
                     if (
                         not seen[peer]
                         and time.monotonic() - start < self.startup_grace
@@ -405,6 +426,8 @@ class WorkerServer:
                         ]
                         self._fanout_abort(session_id, reason, survivors)
                         return
+            if all_live and state.peers:
+                state.progress.bump()
 
     def _send_value(self, request: bytes, context=None) -> bytes:
         # a peer's send may land after this worker aborted the session:
@@ -524,7 +547,10 @@ class ChoreographyClient:
             },
         })
         fn = self._channel.unary_unary(LAUNCH)
-        return _unpack(fn(payload, timeout=30.0))
+        # generous: the payload may be a multi-MB serialized graph and
+        # the worker may be busy; actual graph deserialization happens
+        # off the rpc thread on the worker
+        return _unpack(fn(payload, timeout=120.0))
 
     def retrieve(self, session_id: str, timeout: float = 120.0):
         fn = self._channel.unary_unary(RETRIEVE)

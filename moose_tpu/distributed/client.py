@@ -57,8 +57,21 @@ class GrpcClientRuntime:
         comp_bytes = serialize_computation(compiled)
         session_id = secrets.token_hex(16)
 
+        # each worker receives ONLY the arguments whose Input op lives on
+        # its placement — shipping the full cleartext dict to every party
+        # would hand carole alice's private inputs and void the trust
+        # model this runtime exists for
+        owner_of = {
+            op.name: compiled.placement_of(op).name
+            for op in compiled.operations.values()
+            if op.kind == "Input"
+        }
         for name, client in self._clients.items():
-            resp = client.launch(session_id, comp_bytes, arguments)
+            mine = {
+                arg: v for arg, v in arguments.items()
+                if owner_of.get(arg) == name
+            }
+            resp = client.launch(session_id, comp_bytes, mine)
             if not resp.get("ok"):
                 raise NetworkingError(
                     f"launch on {name} failed: {resp!r}"

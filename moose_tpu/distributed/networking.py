@@ -105,22 +105,38 @@ class _CellStore:
     """Rendezvous-keyed blocking cells: receive may be posted before the
     send arrives (reference AsyncCell store, networking/grpc.rs:189-207)."""
 
+    # bound on remembered per-session activity events (mirrors the
+    # worker server's session-id bookkeeping bound)
+    _MAX_ACTIVITY = 4096
+
     def __init__(self):
         self._lock = threading.Lock()
         self._values: dict = {}
         self._events: dict = {}
-        # set on every arrival: lets a single receive-poller thread sleep
-        # until something (anything) lands instead of spinning
-        self.activity = threading.Event()
+        # per-session arrival wakeups: each session's receive poller
+        # sleeps on ITS event — a shared one would let one session's
+        # poller swallow another's wakeup (clear/wait race), degrading
+        # concurrent sessions to the fallback poll interval
+        self._activity: dict = {}
+
+    def activity_for(self, session_id: str):
+        with self._lock:
+            ev = self._activity.get(session_id)
+            if ev is None:
+                ev = self._activity[session_id] = threading.Event()
+                while len(self._activity) > self._MAX_ACTIVITY:
+                    self._activity.pop(next(iter(self._activity)))
+            return ev
 
     def put(self, key: str, value):
+        session_id = key.split("/", 1)[0]
         with self._lock:
             self._values[key] = value
             ev = self._events.get(key)
             if ev is None:
                 ev = self._events[key] = threading.Event()
         ev.set()
-        self.activity.set()
+        self.activity_for(session_id).set()
 
     def try_take(self, key: str):
         """Non-blocking probe: (True, value) and consume if present."""
@@ -156,6 +172,7 @@ class _CellStore:
             for k in stale:
                 self._events.pop(k, None)
                 self._values.pop(k, None)
+            self._activity.pop(session_id, None)
         return len(stale)
 
 
@@ -190,9 +207,8 @@ class LocalNetworking:
             return deserialize_value(payload, plc)
         return payload
 
-    @property
-    def activity(self):
-        return self._store.activity
+    def activity_for(self, session_id: str):
+        return self._store.activity_for(session_id)
 
     def try_receive(self, sender: str, rendezvous_key: str,
                     session_id: str, plc: str = ""):
@@ -292,7 +308,9 @@ class TcpNetworking:
                 )
                 return True
             except NetworkingError as e:
-                if "timed out" not in str(e):
+                from ..errors import ReceiveTimeoutError
+
+                if not isinstance(e, ReceiveTimeoutError):
                     raise
                 elapsed = _time.monotonic() - t0
                 if elapsed < seconds / 2:
@@ -472,9 +490,8 @@ class GrpcNetworking:
         )
         return deserialize_value(payload, plc)
 
-    @property
-    def activity(self):
-        return self.cells.activity
+    def activity_for(self, session_id: str):
+        return self.cells.activity_for(session_id)
 
     def try_receive(self, sender: str, rendezvous_key: str,
                     session_id: str, plc: str = ""):

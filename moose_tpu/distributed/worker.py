@@ -307,7 +307,8 @@ def execute_role(
     outstanding: dict = {}  # op name -> op, receives awaiting payload
 
     def poll_receives() -> None:
-        activity = getattr(networking, "activity", None)
+        get_act = getattr(networking, "activity_for", None)
+        activity = get_act(session_id) if get_act is not None else None
         while not abort_any.is_set():
             if activity is not None:
                 activity.clear()
@@ -361,9 +362,9 @@ def execute_role(
             if pollable:
                 with recv_lock:
                     outstanding[op.name] = op
-                activity = getattr(networking, "activity", None)
-                if activity is not None:
-                    activity.set()  # wake the poller for the new key
+                get_act = getattr(networking, "activity_for", None)
+                if get_act is not None:
+                    get_act(session_id).set()  # wake poller: new key
             else:
                 # dedicated waiter thread: blocked receives must never
                 # occupy compute slots (deadlock-freedom invariant)

@@ -40,6 +40,13 @@ class NetworkingError(MooseError):
     """Transport-level send/receive failure (reference Error::Networking)."""
 
 
+class ReceiveTimeoutError(NetworkingError, TimeoutError):
+    """A blocking receive expired without its payload arriving.  A
+    DISTINCT class so transports can retry/poll on timeouts without
+    string-matching error messages (which silently breaks when wording
+    changes)."""
+
+
 class StorageError(MooseError, KeyError):
     """Load/Save against a storage backend failed (reference
     Error::Storage)."""

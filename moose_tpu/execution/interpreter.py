@@ -56,6 +56,9 @@ class _Plan:
     dynamic_names: list[str]  # Input/Load ops fed arrays at call time
     use_jit: bool
     core: Callable  # (master_key, dyn: dict[str, array]) -> (outputs, saves)
+    # pre-built executable (segmented plans jit each segment themselves);
+    # when set, the evaluator calls it instead of wrapping `core`
+    fn: Optional[Callable] = None
 
 
 def _is_static_scalar(ty_name: str) -> bool:
@@ -111,6 +114,11 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
     # holds the computation, so the deref below cannot fail in practice.
     comp_ref = weakref.ref(comp)
 
+    if use_jit and len(order) > _segment_limit():
+        return _build_segmented_plan(
+            comp_ref, order, static_env, dynamic_names
+        )
+
     def core(master_key, dyn: dict):
         comp = comp_ref()
         if comp is None:  # pragma: no cover - defensive
@@ -122,57 +130,184 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool) -> _Plan:
         # dict keyed by (placement, storage key) so the returned structure is
         # a valid jit output pytree (strings live in the keys = aux data)
         saves: dict[tuple[str, str], Any] = {}
-        for name in order:
-            op = comp.operations[name]
-            plc = comp.placement_of(op)
-            if name in static_env:
-                env[name] = static_env[name]
-                continue
-            if op.kind in ("Input", "Load"):
-                arr = dyn[name]
-                ret_name = op.signature.return_type.name
-                from ..computation import AES_TY_NAMES
-
-                if ret_name in AES_TY_NAMES:
-                    from ..dialects import aes
-
-                    env[name] = aes.lift_input(sess, comp, op, arr, plc.name)
-                else:
-                    env[name] = _lift_array(arr, op, plc.name)
-                continue
-            if op.kind == "Save":
-                key = env[op.inputs[0]]
-                assert isinstance(key, HostString), (
-                    f"Save key must be a string, found {type(key).__name__}"
-                )
-                value = logical.to_host(sess, plc.name, env[op.inputs[1]])
-                saves[(plc.name, key.value)] = value
-                env[name] = HostUnit(plc.name)
-                continue
-            if op.kind == "Output":
-                value = env[op.inputs[0]]
-                if not isinstance(value, HostUnit):
-                    value = logical.to_host(sess, plc.name, value)
-                env[name] = value
-                outputs[name] = value
-                continue
-            args = [env[i] for i in op.inputs]
-            if trace_ops:
-                # same-named spans aggregate in phase_timings, giving a
-                # per-kind time profile of the eager run.  jax dispatch
-                # is async, so the span must force materialization or
-                # the device time would be misattributed to whichever
-                # later op first blocks (tracing is opt-in; the sync
-                # cost is the price of honest per-op numbers)
-                with telemetry.span(f"op:{op.kind}"):
-                    env[name] = jax.block_until_ready(
-                        logical.execute_op(sess, comp, op, args)
-                    )
-            else:
-                env[name] = logical.execute_op(sess, comp, op, args)
+        _run_ops(
+            sess, comp, order, static_env, env, outputs, saves, dyn,
+            trace_ops,
+        )
         return outputs, saves
 
     return _Plan(order, static_env, dynamic_names, use_jit, core)
+
+
+def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
+             trace_ops=False):
+    """Execute ``names`` in order against ``env`` — the single op-walk
+    shared by the whole-graph core and the per-segment cores."""
+    for name in names:
+        op = comp.operations[name]
+        plc = comp.placement_of(op)
+        if name in static_env:
+            env[name] = static_env[name]
+            continue
+        if op.kind in ("Input", "Load"):
+            arr = dyn[name]
+            ret_name = op.signature.return_type.name
+            from ..computation import AES_TY_NAMES
+
+            if ret_name in AES_TY_NAMES:
+                from ..dialects import aes
+
+                env[name] = aes.lift_input(sess, comp, op, arr, plc.name)
+            else:
+                env[name] = _lift_array(arr, op, plc.name)
+            continue
+        if op.kind == "Save":
+            key = env[op.inputs[0]]
+            assert isinstance(key, HostString), (
+                f"Save key must be a string, found {type(key).__name__}"
+            )
+            value = logical.to_host(sess, plc.name, env[op.inputs[1]])
+            saves[(plc.name, key.value)] = value
+            env[name] = HostUnit(plc.name)
+            continue
+        if op.kind == "Output":
+            value = env[op.inputs[0]]
+            if not isinstance(value, HostUnit):
+                value = logical.to_host(sess, plc.name, value)
+            env[name] = value
+            outputs[name] = value
+            continue
+        args = [env[i] for i in op.inputs]
+        if trace_ops:
+            # same-named spans aggregate in phase_timings, giving a
+            # per-kind time profile of the eager run.  jax dispatch
+            # is async, so the span must force materialization or
+            # the device time would be misattributed to whichever
+            # later op first blocks (tracing is opt-in; the sync
+            # cost is the price of honest per-op numbers)
+            from .. import telemetry
+
+            with telemetry.span(f"op:{op.kind}"):
+                env[name] = jax.block_until_ready(
+                    logical.execute_op(sess, comp, op, args)
+                )
+        else:
+            env[name] = logical.execute_op(sess, comp, op, args)
+
+
+def _segment_limit() -> int:
+    """Above this many ops a jitted plan is split into separately-jitted
+    segments: XLA compile time is superlinear in program size (measured
+    ~quadratic on the CPU backend — an 11k-op softmax graph costs ~340s
+    in one program but tens of seconds as ~2k-op segments), while the
+    segment boundary only costs keeping the crossing values materialized
+    instead of fusing through.  0 disables segmentation."""
+    import os
+
+    raw = os.environ.get("MOOSE_TPU_JIT_SEGMENT", "2000")
+    try:
+        n = int(raw)
+    except ValueError as e:
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            f"MOOSE_TPU_JIT_SEGMENT must be an integer, got {raw!r}"
+        ) from e
+    return n if n > 0 else (1 << 62)
+
+
+def plan_segments(order, static_env, effective_inputs, limit):
+    """Shared boundary-dataflow analysis for segmented execution (used by
+    both the logical and physical executors): split ``order`` into
+    consecutive ``limit``-sized chunks and compute, per chunk, which
+    earlier-produced values it consumes (``in_names``) and which of its
+    values later chunks need (``out_names``).  ``effective_inputs(name)``
+    yields the dataflow inputs of one op (the physical executor maps a
+    Receive to its Send's input here)."""
+    chunks = [order[i:i + limit] for i in range(0, len(order), limit)]
+    produced_by = {}
+    for si, names in enumerate(chunks):
+        for n in names:
+            produced_by[n] = si
+
+    in_names: list[list[str]] = []
+    for si, names in enumerate(chunks):
+        ins = set()
+        for n in names:
+            for i in effective_inputs(n):
+                if i in static_env:
+                    continue
+                if produced_by[i] != si:
+                    ins.add(i)
+        in_names.append(sorted(ins))
+    out_names: list[list[str]] = [[] for _ in chunks]
+    for si in range(len(chunks)):
+        needed = set()
+        for sj in range(si + 1, len(chunks)):
+            needed.update(
+                n for n in in_names[sj] if produced_by[n] == si
+            )
+        out_names[si] = sorted(needed)
+    return chunks, in_names, out_names
+
+
+def _build_segmented_plan(comp_ref, order, static_env, dynamic_names):
+    """Split the op order into consecutive segments, jit each as its own
+    XLA program, and orchestrate them from the host.  Values crossing a
+    boundary travel as jit inputs/outputs (all moose value types are
+    registered pytrees).  Each segment runs its own EagerSession over the
+    same master key with a distinct key domain, so PRF streams never
+    collide across segments."""
+    comp = comp_ref()
+    chunks, in_names, out_names = plan_segments(
+        order, static_env,
+        lambda n: comp.operations[n].inputs,
+        _segment_limit(),
+    )
+    dyn_of = [
+        [n for n in names if n in set(dynamic_names)]
+        for names in chunks
+    ]
+
+    def make_seg(si, names):
+        outs = out_names[si]
+
+        def seg(master_key, dyn, env_in):
+            comp = comp_ref()
+            if comp is None:  # pragma: no cover - defensive
+                raise RuntimeError("computation was garbage-collected")
+            sess = EagerSession(master_key=master_key, key_domain=si + 1)
+            logical.bind_placements(sess, comp)
+            # seed with every static value: a static op executed in an
+            # earlier segment is not in env_in (statics never cross as
+            # jit values) but may feed any later segment
+            env: dict[str, Any] = dict(static_env)
+            env.update(env_in)
+            outputs: dict[str, Any] = {}
+            saves: dict[tuple[str, str], Any] = {}
+            _run_ops(
+                sess, comp, names, static_env, env, outputs, saves, dyn
+            )
+            return {n: env[n] for n in outs}, outputs, saves
+
+        return jax.jit(seg)
+
+    seg_fns = [make_seg(si, names) for si, names in enumerate(chunks)]
+
+    def run(master_key, dyn: dict):
+        env: dict[str, Any] = {}
+        outputs: dict[str, Any] = {}
+        saves: dict[tuple[str, str], Any] = {}
+        for si, fn in enumerate(seg_fns):
+            dyn_i = {n: dyn[n] for n in dyn_of[si]}
+            env_in = {n: env[n] for n in in_names[si]}
+            env_out, out_i, sv_i = fn(master_key, dyn_i, env_in)
+            env.update(env_out)
+            outputs.update(out_i)
+            saves.update(sv_i)
+        return outputs, saves
+
+    return _Plan(order, static_env, dynamic_names, True, run, fn=run)
 
 
 class _DeviceCache:
@@ -301,7 +436,10 @@ class Interpreter:
         if cached is None:
             with telemetry.span("build_plan", n_ops=len(comp.operations)):
                 plan = build_plan(comp, arguments, use_jit)
-                fn = jax.jit(plan.core) if plan.use_jit else plan.core
+                if plan.fn is not None:  # segmented: already jitted
+                    fn = plan.fn
+                else:
+                    fn = jax.jit(plan.core) if plan.use_jit else plan.core
             per_comp[cache_key] = (plan, fn)
         else:
             plan, fn = cached

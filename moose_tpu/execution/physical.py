@@ -245,6 +245,78 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
 _DYNAMIC_SHAPE_KINDS = frozenset({"Select"})
 
 
+def _recv_sources(comp: Computation, order) -> dict:
+    """Map each Receive op to the env name of its Send's input: in-process
+    execution needs no rendezvous store — the received value IS the sent
+    value (and expressing it as a dataflow edge lets the segmented
+    executor carry it across segment boundaries like any other value)."""
+    send_of: dict[str, str] = {}
+    for n in order:
+        op = comp.operations[n]
+        if op.kind == "Send":
+            send_of[op.attributes["rendezvous_key"]] = op.inputs[0]
+    out = {}
+    for n in order:
+        op = comp.operations[n]
+        if op.kind == "Receive":
+            out[n] = send_of[op.attributes["rendezvous_key"]]
+    return out
+
+
+def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
+                      keys, dyn, recv_src, trace_ops=False):
+    """Execute host-level ops in order against ``env`` — shared by the
+    whole-graph core and the per-segment cores."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import telemetry
+    from .interpreter import _lift_array
+
+    for n in names:
+        op = comp.operations[n]
+        plc = comp.placement_of(op).name
+        if n in env:
+            continue
+        if op.kind == "Send":
+            env[n] = HostUnit(plc)
+            continue
+        if op.kind == "Receive":
+            env[n] = host.place(env[recv_src[n]], plc)
+            continue
+        if op.kind == "PrfKeyGen":
+            env[n] = HostPrfKey(jnp.asarray(keys[n]), plc)
+            continue
+        if op.kind in ("Input", "Load"):
+            env[n] = _lift_array(dyn[n], op, plc)
+            continue
+        if op.kind == "Save":
+            key = env[op.inputs[0]]
+            if not isinstance(key, HostString):
+                raise KernelError(
+                    f"Save {n}: key must be a string, found "
+                    f"{type(key).__name__}"
+                )
+            saves[(plc, key.value)] = env[op.inputs[1]]
+            env[n] = HostUnit(plc)
+            continue
+        if op.kind == "Output":
+            value = env[op.inputs[0]]
+            env[n] = value
+            outputs[n] = value
+            continue
+        args = [env[i] for i in op.inputs]
+        if trace_ops:
+            # block inside the span: async dispatch would otherwise
+            # misattribute device time (see interpreter.build_plan)
+            with telemetry.span(f"op:{op.kind}"):
+                env[n] = jax.block_until_ready(
+                    execute_kernel(sess, op, plc, args)
+                )
+        else:
+            env[n] = execute_kernel(sess, op, plc, args)
+
+
 def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
     """Build (and jit) the execution closure for one (computation,
     binding) pair; cached by PhysicalInterpreter across calls."""
@@ -276,14 +348,19 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
     from .. import telemetry
 
     comp_ref = weakref.ref(comp)
+    recv_src = _recv_sources(comp, order)
     # per-op spans in eager mode only (see interpreter.build_plan)
     trace_ops = telemetry.trace_ops_enabled() and not use_jit
 
+    from .interpreter import _segment_limit
+
+    if use_jit and len(order) > _segment_limit():
+        fn = _build_segmented_physical(
+            comp_ref, order, static_env, dyn_names, key_ops, recv_src
+        )
+        return order, key_ops, dyn_names, static_env, fn
+
     def core(keys: dict, dyn: dict):
-        import jax.numpy as jnp
-
-        from .interpreter import _lift_array
-
         comp = comp_ref()
         if comp is None:  # pragma: no cover - defensive
             raise KernelError("computation was garbage-collected")
@@ -291,57 +368,80 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
         env: dict[str, Any] = dict(static_env)
         outputs: dict[str, Any] = {}
         saves: dict[tuple, Any] = {}
-        # in-process rendezvous store: Send deposits, Receive collects
-        # (toposort stitched the Send before its Receive)
-        rendezvous: dict[str, Any] = {}
-        for n in order:
-            op = comp.operations[n]
-            plc = comp.placement_of(op).name
-            if n in env:
-                continue
-            if op.kind == "Send":
-                rendezvous[op.attributes["rendezvous_key"]] = env[op.inputs[0]]
-                env[n] = HostUnit(plc)
-                continue
-            if op.kind == "Receive":
-                value = rendezvous[op.attributes["rendezvous_key"]]
-                env[n] = host.place(value, plc)
-                continue
-            if op.kind == "PrfKeyGen":
-                env[n] = HostPrfKey(jnp.asarray(keys[n]), plc)
-                continue
-            if op.kind in ("Input", "Load"):
-                env[n] = _lift_array(dyn[n], op, plc)
-                continue
-            if op.kind == "Save":
-                key = env[op.inputs[0]]
-                if not isinstance(key, HostString):
-                    raise KernelError(
-                        f"Save {n}: key must be a string, found "
-                        f"{type(key).__name__}"
-                    )
-                saves[(plc, key.value)] = env[op.inputs[1]]
-                env[n] = HostUnit(plc)
-                continue
-            if op.kind == "Output":
-                value = env[op.inputs[0]]
-                env[n] = value
-                outputs[n] = value
-                continue
-            args = [env[i] for i in op.inputs]
-            if trace_ops:
-                # block inside the span: async dispatch would otherwise
-                # misattribute device time (see interpreter.build_plan)
-                with telemetry.span(f"op:{op.kind}"):
-                    env[n] = jax.block_until_ready(
-                        execute_kernel(sess, op, plc, args)
-                    )
-            else:
-                env[n] = execute_kernel(sess, op, plc, args)
+        _run_physical_ops(
+            sess, comp, order, static_env, env, outputs, saves, keys,
+            dyn, recv_src, trace_ops,
+        )
         return outputs, saves
 
     fn = jax.jit(core) if use_jit else core
     return order, key_ops, dyn_names, static_env, fn
+
+
+def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
+                              key_ops, recv_src):
+    """Segment a lowered graph into separately-jitted XLA programs (see
+    interpreter._build_segmented_plan for the rationale).  Receive ops
+    read their Send's input through ``recv_src``, so cross-segment
+    transfers are ordinary boundary values."""
+    import jax
+
+    from .interpreter import _segment_limit, plan_segments
+
+    comp = comp_ref()
+
+    def effective_inputs(n):
+        op = comp.operations[n]
+        if op.kind == "Receive":
+            return [recv_src[op.name]]
+        return op.inputs
+
+    chunks, in_names, out_names = plan_segments(
+        order, static_env, effective_inputs, _segment_limit()
+    )
+    dyn_set = set(dyn_names)
+    key_set = set(key_ops)
+    dyn_of = [[n for n in names if n in dyn_set] for names in chunks]
+    keys_of = [[n for n in names if n in key_set] for names in chunks]
+
+    def make_seg(si, names):
+        outs = out_names[si]
+
+        def seg(keys, dyn, env_in):
+            comp = comp_ref()
+            if comp is None:  # pragma: no cover - defensive
+                raise KernelError("computation was garbage-collected")
+            sess = EagerSession()
+            env: dict[str, Any] = dict(static_env)
+            env.update(env_in)
+            outputs: dict[str, Any] = {}
+            saves: dict[tuple, Any] = {}
+            _run_physical_ops(
+                sess, comp, names, static_env, env, outputs, saves,
+                keys, dyn, recv_src,
+            )
+            return {n: env[n] for n in outs}, outputs, saves
+
+        return jax.jit(seg)
+
+    seg_fns = [make_seg(si, names) for si, names in enumerate(chunks)]
+
+    def run(keys: dict, dyn: dict):
+        env: dict[str, Any] = {}
+        outputs: dict[str, Any] = {}
+        saves: dict[tuple, Any] = {}
+        for si, fn in enumerate(seg_fns):
+            env_out, out_i, sv_i = fn(
+                {n: keys[n] for n in keys_of[si]},
+                {n: dyn[n] for n in dyn_of[si]},
+                {n: env[n] for n in in_names[si]},
+            )
+            env.update(env_out)
+            outputs.update(out_i)
+            saves.update(sv_i)
+        return outputs, saves
+
+    return run
 
 
 class PhysicalInterpreter:

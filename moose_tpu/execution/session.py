@@ -48,12 +48,17 @@ class EagerSession:
     party keys inside one process).
     """
 
-    def __init__(self, session_id: Optional[str] = None, master_key=None):
+    def __init__(self, session_id: Optional[str] = None, master_key=None,
+                 key_domain: int = 0):
         self.session_id = session_id or secrets.token_hex(8)
         if master_key is None:
             master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
         self._master = jnp.asarray(master_key, dtype=jnp.uint32)
         self._key_counter = 0
+        # distinct domains partition the key-derivation nonce space, so
+        # several sessions sharing one master key (the segmented-jit
+        # executor runs one session per graph segment) never collide
+        self._key_domain = int(key_domain)
         self._setup_cache: dict[str, object] = {}
 
     # -- setup cache (reference execution/synchronous.rs:297-307) ----------
@@ -75,7 +80,10 @@ class EagerSession:
 
         idx = self._key_counter
         self._key_counter += 1
-        nonce = np.array([idx, 0x6B657921, idx ^ 0xDEADBEEF, 1], np.uint32)
+        nonce = np.array(
+            [idx, 0x6B657921 ^ self._key_domain, idx ^ 0xDEADBEEF, 1],
+            np.uint32,
+        )
         return HostPrfKey(ring.mix_seed(self._master, nonce), plc)
 
     def derive_seed(self, plc: str, key: HostPrfKey, sync_key: bytes) -> HostSeed:

@@ -10,7 +10,7 @@ import subprocess
 import threading
 from pathlib import Path
 
-from ..errors import NetworkingError
+from ..errors import NetworkingError, ReceiveTimeoutError
 
 _HERE = Path(__file__).resolve().parent
 _SRC = _HERE / "tcp_transport.cpp"
@@ -81,7 +81,7 @@ class ServerHandle:
             ctypes.byref(out_len), timeout_ms,
         )
         if rc != 0:
-            raise NetworkingError(
+            raise ReceiveTimeoutError(
                 f"TCP receive timed out ({timeout_ms} ms) for {key!r}"
             )
         try:

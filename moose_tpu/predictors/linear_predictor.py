@@ -1,21 +1,28 @@
-"""Linear regression / classification predictors (reference:
-``pymoose/pymoose/predictors/linear_predictor.py``).
+"""Linear regression / classification predictors.
 
-Imports the ``ai.onnx.ml`` LinearRegressor / LinearClassifier operators and
-builds the encrypted inference graph: one replicated fixed-point ``dot``
-against mirrored weights (with the intercept folded in via the bias trick)
-followed by the model's post-transform (sigmoid / softmax / none).
+Imports the ``ai.onnx.ml`` LinearRegressor / LinearClassifier operators
+(same operator coverage as the reference's
+``pymoose/pymoose/predictors/linear_predictor.py``) and builds the
+encrypted inference graph: one replicated fixed-point ``dot`` against
+mirrored weights — the intercept folded in by augmenting the input with a
+ones column — followed by the model's post-transform.
+
+Internal shape: the model is a frozen :class:`LinearWeights` value whose
+normalization/validation lives in its constructor, ONNX attribute
+handling goes through small typed readers, and the classifier's head is
+resolved from a declarative table.
 """
 
 import abc
+import dataclasses
 from enum import Enum
+from typing import Optional
 
 import numpy as np
 
 import moose_tpu as pm
 
-from . import predictor
-from . import predictor_utils
+from . import predictor, predictor_utils
 
 
 class PostTransform(Enum):
@@ -26,10 +33,72 @@ class PostTransform(Enum):
     SOFTMAX = 3
 
 
+@dataclasses.dataclass(frozen=True)
+class LinearWeights:
+    """Validated (coefficients, optional intercepts) pair.
+
+    ``coeffs`` is (n_outputs, n_features); ``intercepts`` is
+    (1, n_outputs) or None.  Construction normalizes vector inputs and
+    rejects incompatible shapes, so every consumer downstream can rely
+    on the layout.
+    """
+
+    coeffs: np.ndarray
+    intercepts: Optional[np.ndarray]
+
+    @classmethod
+    def of(cls, coeffs, intercepts) -> "LinearWeights":
+        coeffs = np.asarray(coeffs, dtype=np.float64)
+        if coeffs.ndim == 1:
+            coeffs = coeffs[None, :]
+        elif coeffs.ndim != 2:
+            raise ValueError(
+                "Coeffs must be convertible to a rank-2 tensor, found "
+                f"shape of {coeffs.shape}."
+            )
+        if intercepts is not None:
+            intercepts = np.asarray(intercepts, dtype=np.float64)
+            if intercepts.ndim == 1:
+                intercepts = intercepts[None, :]
+            if intercepts.ndim != 2 or intercepts.shape[0] != 1:
+                raise ValueError(
+                    "Intercept must be convertible to a vector, found "
+                    f"shape of {intercepts.shape}."
+                )
+            if coeffs.shape[0] != intercepts.shape[-1]:
+                raise ValueError(
+                    "Shape mismatch between model coefficients and "
+                    f"intercepts: Intercepts size of {coeffs.shape[0]} "
+                    "inferred from coefficients, found "
+                    f"{intercepts.shape[-1]}."
+                )
+        return cls(coeffs, intercepts)
+
+    @property
+    def n_outputs(self) -> int:
+        return self.coeffs.shape[0]
+
+    def augmented_matrix(self) -> np.ndarray:
+        """[b; W]^T — the single mirrored constant the dot consumes when
+        an intercept is present."""
+        return np.concatenate(
+            [self.intercepts.T, self.coeffs], axis=1
+        ).T
+
+
 class LinearPredictor(predictor.Predictor, metaclass=abc.ABCMeta):
     def __init__(self, coeffs, intercepts=None):
         super().__init__()
-        self.coeffs, self.intercepts = _validate_model_args(coeffs, intercepts)
+        self._weights = LinearWeights.of(coeffs, intercepts)
+
+    # reference-era attribute surface
+    @property
+    def coeffs(self) -> np.ndarray:
+        return self._weights.coeffs
+
+    @property
+    def intercepts(self) -> Optional[np.ndarray]:
+        return self._weights.intercepts
 
     @classmethod
     @abc.abstractmethod
@@ -44,30 +113,89 @@ class LinearPredictor(predictor.Predictor, metaclass=abc.ABCMeta):
     def bias_trick(cls, x, plc, dtype):
         """A column of ones broadcastable against ``x``, so the intercept
         rides the same dot product as the coefficients."""
-        bias_shape = pm.shape(x, placement=plc)[0:1]
-        bias = pm.ones(bias_shape, dtype=pm.float64, placement=plc)
-        reshaped_bias = pm.expand_dims(bias, 1, placement=plc)
-        return pm.cast(reshaped_bias, dtype=dtype, placement=plc)
+        ones = pm.ones(
+            pm.shape(x, placement=plc)[0:1], dtype=pm.float64,
+            placement=plc,
+        )
+        return pm.cast(
+            pm.expand_dims(ones, 1, placement=plc), dtype=dtype,
+            placement=plc,
+        )
 
     def predictor_fn(self, x, fixedpoint_dtype):
         """The core linear map y = [1; x] @ [b; W]^T on shares."""
-        if self.intercepts is not None:
-            w = self.fixedpoint_constant(
-                np.concatenate([self.intercepts.T, self.coeffs], axis=1).T,
-                plc=self.mirrored,
-                dtype=fixedpoint_dtype,
-            )
-            bias = self.bias_trick(x, plc=self.bob, dtype=fixedpoint_dtype)
-            x = pm.concatenate([bias, x], axis=1)
+        w = self._weights
+        if w.intercepts is None:
+            matrix = w.coeffs.T
         else:
-            w = self.fixedpoint_constant(
-                self.coeffs.T, plc=self.mirrored, dtype=fixedpoint_dtype
-            )
-        return pm.dot(x, w)
+            matrix = w.augmented_matrix()
+            ones = self.bias_trick(x, plc=self.bob, dtype=fixedpoint_dtype)
+            x = pm.concatenate([ones, x], axis=1)
+        mirrored_w = self.fixedpoint_constant(
+            matrix, plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        return pm.dot(x, mirrored_w)
 
     def __call__(self, x, fixedpoint_dtype=predictor_utils.DEFAULT_FIXED_DTYPE):
-        y = self.predictor_fn(x, fixedpoint_dtype)
-        return self.post_transform(y)
+        return self.post_transform(self.predictor_fn(x, fixedpoint_dtype))
+
+
+# ---------------------------------------------------------------------------
+# Typed ONNX attribute readers
+# ---------------------------------------------------------------------------
+
+_FLOATS_ATTR_TYPE = 6  # AttributeProto.FLOATS
+
+
+def _read_floats(node, name, required=True) -> Optional[np.ndarray]:
+    attr = predictor_utils.find_attribute_in_node(node, name, enforce=False)
+    if attr is None:
+        if required:
+            raise ValueError(
+                f"{node.op_type} is missing required attribute {name!r}"
+            )
+        return None
+    if attr.type != _FLOATS_ATTR_TYPE:
+        raise ValueError(
+            f"{node.op_type} {name} must be of type FLOATS, found other."
+        )
+    return np.asarray(list(attr.floats), dtype=np.float64)
+
+
+def _read_class_count(node) -> int:
+    for attr_name in ("classlabels_ints", "classlabels_strings"):
+        attr = predictor_utils.find_attribute_in_node(
+            node, attr_name, enforce=False
+        )
+        if attr is None:
+            continue
+        labels = attr.ints if attr_name == "classlabels_ints" else attr.strings
+        if len(labels):
+            return len(labels)
+    raise ValueError("LinearClassifier carries no class labels")
+
+
+def _require_node(model_proto, op_type):
+    node = predictor_utils.find_node_in_model_proto(
+        model_proto, op_type, enforce=False
+    )
+    if node is None:
+        raise ValueError(
+            "Incompatible ONNX graph provided: graph must contain a "
+            f"{op_type} operator."
+        )
+    return node
+
+
+def _check_feature_count(model_proto, n_coeffs):
+    n_features = predictor_utils.input_n_features(model_proto)
+    if n_features != n_coeffs:
+        raise ValueError(
+            f"In the ONNX file, the input shape has {n_features} "
+            f"features and there are {n_coeffs} coefficients. Validate "
+            "you set correctly the `initial_types` when converting "
+            "your model to ONNX."
+        )
 
 
 class LinearRegressor(LinearPredictor):
@@ -83,34 +211,50 @@ class LinearRegressor(LinearPredictor):
 
     @classmethod
     def from_onnx(cls, model_proto):
-        lr_node = predictor_utils.find_node_in_model_proto(
-            model_proto, "LinearRegressor", enforce=False
+        node = _require_node(model_proto, "LinearRegressor")
+        coeffs = _read_floats(node, "coefficients")
+        intercepts = _read_floats(node, "intercepts", required=False)
+        targets = predictor_utils.find_attribute_in_node(
+            node, "targets", enforce=False
         )
-        if lr_node is None:
-            raise ValueError(
-                "Incompatible ONNX graph provided: graph must contain a "
-                "LinearRegressor operator."
-            )
-
-        coeffs = _floats_attr(lr_node, "coefficients")
-        intercepts_attr = predictor_utils.find_attribute_in_node(
-            lr_node, "intercepts", enforce=False
-        )
-        intercepts = (
-            None
-            if intercepts_attr is None
-            else _check_floats(intercepts_attr, "LinearRegressor intercepts")
-        )
-
-        n_targets_attr = predictor_utils.find_attribute_in_node(
-            lr_node, "targets", enforce=False
-        )
-        if n_targets_attr is not None:
-            coeffs = coeffs.reshape(n_targets_attr.i, -1)
-
-        n_coeffs = coeffs.shape[-1]
-        _check_n_features(model_proto, n_coeffs)
+        if targets is not None:
+            coeffs = coeffs.reshape(targets.i, -1)
+        _check_feature_count(model_proto, coeffs.shape[-1])
         return cls(coeffs=coeffs, intercepts=intercepts)
+
+
+# ONNX post_transform attribute -> (enum, head builder factory).  The
+# builder receives n_classes and returns the graph function.
+def _sigmoid_head(n_classes):
+    if n_classes < 2:
+        raise ValueError(
+            "Could not infer post-transform in LinearClassifier"
+        )
+    if n_classes == 2:
+        return lambda y: pm.sigmoid(y)
+
+    def normalized(y):
+        # sklearn's OvR probability normalization: sigmoid then divide
+        # by the row sum (instead of softmax)
+        s = pm.sigmoid(y)
+        return pm.div(s, pm.expand_dims(pm.sum(s, 1), 1))
+
+    return normalized
+
+
+_HEADS = {
+    PostTransform.NONE: lambda n: (lambda y: y),
+    PostTransform.SIGMOID: _sigmoid_head,
+    PostTransform.SOFTMAX: lambda n: (
+        lambda y: pm.softmax(y, axis=1, upmost_index=n)
+    ),
+}
+
+_ONNX_POST_TRANSFORMS = {
+    "NONE": PostTransform.NONE,
+    "LOGISTIC": PostTransform.SIGMOID,
+    "SOFTMAX": PostTransform.SOFTMAX,
+}
 
 
 class LinearClassifier(LinearPredictor):
@@ -125,155 +269,36 @@ class LinearClassifier(LinearPredictor):
 
     def __init__(self, coeffs, intercepts=None, post_transform=None):
         super().__init__(coeffs, intercepts)
-        n_classes = self.coeffs.shape[0]
-        if post_transform == PostTransform.NONE:
-            self._post_transform = lambda x: x
-        elif post_transform == PostTransform.SIGMOID and n_classes == 2:
-            self._post_transform = lambda x: pm.sigmoid(x)
-        elif post_transform == PostTransform.SIGMOID and n_classes > 2:
-            self._post_transform = lambda x: self._normalized_sigmoid(
-                x, axis=1
-            )
-        elif post_transform == PostTransform.SOFTMAX:
-            self._post_transform = lambda x: pm.softmax(
-                x, axis=1, upmost_index=n_classes
-            )
-        else:
+        head_factory = _HEADS.get(post_transform)
+        if head_factory is None:
             raise ValueError(
                 "Could not infer post-transform in LinearClassifier"
             )
+        self._head = head_factory(self._weights.n_outputs)
 
     @classmethod
     def from_onnx(cls, model_proto):
-        lc_node = predictor_utils.find_node_in_model_proto(
-            model_proto, "LinearClassifier", enforce=False
+        node = _require_node(model_proto, "LinearClassifier")
+        n_classes = _read_class_count(node)
+        coeffs = _read_floats(node, "coefficients").reshape(n_classes, -1)
+        _check_feature_count(model_proto, coeffs.shape[1])
+        intercepts = _read_floats(node, "intercepts", required=False)
+        if intercepts is not None:
+            intercepts = intercepts.reshape(1, n_classes)
+        pt_attr = predictor_utils.find_attribute_in_node(
+            node, "post_transform"
         )
-        if lc_node is None:
-            raise ValueError(
-                "Incompatible ONNX graph provided: graph must contain a "
-                "LinearClassifier operator."
-            )
-
-        coeffs = _floats_attr(lc_node, "coefficients")
-
-        classlabels = _classlabels(lc_node)
-        n_classes = len(classlabels)
-        coeffs = coeffs.reshape(n_classes, -1)
-        _check_n_features(model_proto, coeffs.shape[1])
-
-        intercepts_attr = predictor_utils.find_attribute_in_node(
-            lc_node, "intercepts", enforce=False
-        )
-        intercepts = (
-            None
-            if intercepts_attr is None
-            else _check_floats(
-                intercepts_attr, "LinearClassifier intercepts"
-            ).reshape(1, n_classes)
-        )
-
-        post_transform_attr = predictor_utils.find_attribute_in_node(
-            lc_node, "post_transform"
-        )
-        post_transform_str = bytes(post_transform_attr.s).decode()
-        try:
-            post_transform = {
-                "NONE": PostTransform.NONE,
-                "LOGISTIC": PostTransform.SIGMOID,
-                "SOFTMAX": PostTransform.SOFTMAX,
-            }[post_transform_str]
-        except KeyError:
+        pt_name = bytes(pt_attr.s).decode()
+        post_transform = _ONNX_POST_TRANSFORMS.get(pt_name)
+        if post_transform is None:
             raise RuntimeError(
-                f"{post_transform_str} post_transform is unsupported for "
+                f"{pt_name} post_transform is unsupported for "
                 "LinearClassifier."
             )
-
         return cls(
-            coeffs=coeffs,
-            intercepts=intercepts,
+            coeffs=coeffs, intercepts=intercepts,
             post_transform=post_transform,
         )
 
     def post_transform(self, y):
-        return self._post_transform(y)
-
-    def _normalized_sigmoid(self, x, axis):
-        """sklearn's OvR probability normalization: sigmoid then divide by
-        the row sum (instead of softmax)."""
-        y = pm.sigmoid(x)
-        y_sum = pm.expand_dims(pm.sum(y, axis), axis)
-        return pm.div(y, y_sum)
-
-
-def _floats_attr(node, name):
-    attr = predictor_utils.find_attribute_in_node(node, name)
-    return _check_floats(attr, f"{node.op_type} {name}")
-
-
-def _check_floats(attr, what):
-    if attr.type != 6:  # AttributeProto.FLOATS
-        raise ValueError(f"{what} must be of type FLOATS, found other.")
-    return np.asarray(list(attr.floats), dtype=np.float64)
-
-
-def _classlabels(node):
-    ints = predictor_utils.find_attribute_in_node(
-        node, "classlabels_ints", enforce=False
-    )
-    strings = predictor_utils.find_attribute_in_node(
-        node, "classlabels_strings", enforce=False
-    )
-    if ints is not None and len(ints.ints):
-        return list(ints.ints)
-    if strings is not None and len(strings.strings):
-        return list(strings.strings)
-    raise ValueError("LinearClassifier carries no class labels")
-
-
-def _check_n_features(model_proto, n_coeffs):
-    n_features = predictor_utils.input_n_features(model_proto)
-    if n_features != n_coeffs:
-        raise ValueError(
-            f"In the ONNX file, the input shape has {n_features} "
-            f"features and there are {n_coeffs} coefficients. Validate "
-            "you set correctly the `initial_types` when converting "
-            "your model to ONNX."
-        )
-
-
-def _validate_model_args(coeffs, intercepts):
-    coeffs = _interpret_coeffs(coeffs)
-    intercepts = _interpret_intercepts(intercepts)
-    if intercepts is not None and coeffs.shape[0] != intercepts.shape[-1]:
-        raise ValueError(
-            "Shape mismatch between model coefficients and intercepts: "
-            f"Intercepts size of {coeffs.shape[0]} inferred from "
-            f"coefficients, found {intercepts.shape[-1]}."
-        )
-    return coeffs, intercepts
-
-
-def _interpret_coeffs(coeffs):
-    coeffs = np.asarray(coeffs, dtype=np.float64)
-    if coeffs.ndim == 1:
-        return np.expand_dims(coeffs, 0)
-    if coeffs.ndim == 2:
-        return coeffs
-    raise ValueError(
-        "Coeffs must be convertible to a rank-2 tensor, found shape of "
-        f"{coeffs.shape}."
-    )
-
-
-def _interpret_intercepts(intercepts):
-    if intercepts is None:
-        return None
-    intercepts = np.asarray(intercepts, dtype=np.float64)
-    if intercepts.ndim == 1:
-        return np.expand_dims(intercepts, 0)
-    if intercepts.ndim == 2 and intercepts.shape[0] == 1:
-        return intercepts
-    raise ValueError(
-        f"Intercept must be convertible to a vector, found shape of "
-        f"{intercepts.shape}."
-    )
+        return self._head(y)

@@ -1,9 +1,14 @@
-"""sklearn MLP predictors (reference:
-``pymoose/pymoose/predictors/multilayer_perceptron_predictor.py``).
+"""sklearn MLP predictors over the shared dense-stack core.
 
-Imports skl2onnx-exported MLPRegressor/MLPClassifier graphs: stacked
-``coefficient``/``intercepts`` initializers with one hidden activation
-(sigmoid / relu / identity) shared across hidden layers.
+Imports skl2onnx-exported MLPRegressor/MLPClassifier graphs (stacked
+``coefficient``/``intercepts`` initializers, one hidden activation shared
+across hidden layers) — same models as the reference's
+``pymoose/pymoose/predictors/multilayer_perceptron_predictor.py``, but
+the network is a :class:`~.layers.DenseStack` value and the graph emission
+lives in one place (:meth:`DenseStack.build`) for every predictor family.
+
+The reference-era surface (``Activation`` enum, ``weights``/``biases``/
+``activation`` attributes, ``from_onnx``) is preserved.
 """
 
 import abc
@@ -13,9 +18,8 @@ import numpy as np
 
 import moose_tpu as pm
 
-from . import onnx_proto
-from . import predictor
-from . import predictor_utils
+from . import predictor, predictor_utils
+from .layers import DenseStack, stack_from_sklearn_mlp
 
 
 class Activation(Enum):
@@ -24,81 +28,56 @@ class Activation(Enum):
     RELU = 3
 
 
+_KEY_TO_ENUM = {
+    "identity": Activation.IDENTITY,
+    "sigmoid": Activation.SIGMOID,
+    "relu": Activation.RELU,
+}
+_ENUM_TO_KEY = {v: k for k, v in _KEY_TO_ENUM.items()}
+
+
 class MLPPredictor(predictor.Predictor, metaclass=abc.ABCMeta):
     def __init__(self, weights, biases, activation):
         super().__init__()
-        self.weights = weights
-        self.biases = biases
+        self.weights = [np.asarray(w, dtype=np.float64) for w in weights]
+        self.biases = [
+            np.asarray(b, dtype=np.float64).ravel() for b in biases
+        ]
         self.activation = activation
+        hidden = _ENUM_TO_KEY[activation]
+        from .layers import DenseLayer
+
+        self._stack = DenseStack(tuple(
+            DenseLayer(
+                w, b,
+                hidden if i < len(self.weights) - 1 else "identity",
+            )
+            for i, (w, b) in enumerate(zip(self.weights, self.biases))
+        ))
 
     @classmethod
     def from_onnx(cls, model_proto):
-        weights_data = predictor_utils.find_parameters_in_model_proto(
-            model_proto, ["coefficient"], enforce=False
+        stack, hidden_key = stack_from_sklearn_mlp(model_proto)
+        return cls(
+            [layer.weights for layer in stack.layers],
+            [layer.bias for layer in stack.layers],
+            _KEY_TO_ENUM[hidden_key],
         )
-        biases_data = predictor_utils.find_parameters_in_model_proto(
-            model_proto, ["intercepts"], enforce=False
-        )
-        weights = [
-            onnx_proto.tensor_to_numpy(w).astype(np.float64)
-            for w in weights_data
-        ]
-        biases = [
-            onnx_proto.tensor_to_numpy(b).astype(np.float64).ravel()
-            for b in biases_data
-        ]
-
-        n_features = predictor_utils.input_n_features(model_proto)
-        if n_features != weights[0].shape[0]:
-            raise ValueError(
-                f"In the ONNX file, the input shape has {n_features} "
-                "features and the shape of the weights for the first "
-                f"layer is: {weights[0].shape}. Validate you set "
-                "correctly the `initial_types` when converting "
-                "your model to ONNX."
-            )
-
-        activation_str = predictor_utils.find_activation_in_model_proto(
-            model_proto, "next_activations", enforce=False
-        )
-        if activation_str == "Sigmoid":
-            activation = Activation.SIGMOID
-        elif activation_str == "Relu":
-            activation = Activation.RELU
-        else:
-            activation = Activation.IDENTITY
-
-        return cls(weights, biases, activation)
 
     @abc.abstractmethod
     def post_transform(self, y, fixedpoint_dtype):
         pass
 
-    def apply_layer(self, input, i, fixedpoint_dtype):
-        w = self.fixedpoint_constant(
-            self.weights[i], plc=self.mirrored, dtype=fixedpoint_dtype
+    def _mirrored_constant(self, value, dtype):
+        return self.fixedpoint_constant(
+            value, plc=self.mirrored, dtype=dtype
         )
-        b = self.fixedpoint_constant(
-            self.biases[i], plc=self.mirrored, dtype=fixedpoint_dtype
-        )
-        return pm.add(pm.dot(input, w), b)
-
-    def activation_fn(self, z, fixedpoint_dtype):
-        if self.activation == Activation.SIGMOID:
-            return pm.sigmoid(z)
-        if self.activation == Activation.RELU:
-            return pm.relu(z)
-        if self.activation == Activation.IDENTITY:
-            return z
-        raise ValueError("Invalid or unsupported activation function")
 
     def neural_predictor_fn(self, x, fixedpoint_dtype):
-        num_hidden_layers = len(self.weights) - 1
-        for i in range(num_hidden_layers + 1):
-            x = self.apply_layer(x, i, fixedpoint_dtype)
-            if i < num_hidden_layers:
-                x = self.activation_fn(x, fixedpoint_dtype)
-        return x
+        return self._stack.build(
+            x, fixedpoint_dtype,
+            lambda v, dtype: self._mirrored_constant(v, dtype),
+        )
 
     def predictor_fn(self, x, fixedpoint_dtype):
         return self.neural_predictor_fn(x, fixedpoint_dtype)
@@ -117,18 +96,12 @@ class MLPRegressor(MLPPredictor):
 
 class MLPClassifier(MLPPredictor):
     def post_transform(self, y, fixedpoint_dtype):
-        n_classes = np.shape(self.biases[-1])[0]
+        n_classes = self._stack.n_outputs
         if n_classes == 1:
-            return self._sigmoid(y, fixedpoint_dtype)
+            # binary head: emit both class probabilities, sklearn-style
+            pos = pm.sigmoid(y)
+            one = self._mirrored_constant(1, fixedpoint_dtype)
+            return pm.concatenate([pm.sub(one, pos), pos], axis=1)
         if n_classes > 1:
             return pm.softmax(y, axis=1, upmost_index=n_classes)
         raise ValueError("Specify number of classes")
-
-    def _sigmoid(self, y, fixedpoint_dtype):
-        """Binary case: return both class probabilities."""
-        pos_prob = pm.sigmoid(y)
-        one = self.fixedpoint_constant(
-            1, plc=self.mirrored, dtype=fixedpoint_dtype
-        )
-        neg_prob = pm.sub(one, pos_prob)
-        return pm.concatenate([neg_prob, pos_prob], axis=1)

@@ -1,21 +1,22 @@
-"""Generic feed-forward NN predictor for pytorch / tf2onnx exports
-(reference: ``pymoose/pymoose/predictors/neural_network_predictor.py``).
+"""Generic feed-forward NN predictor for pytorch / tf2onnx exports, over
+the shared dense-stack core.
 
-Walks the exported graph's Gemm/MatMul+Add structure, reads the
-weight/bias initializers, and rebuilds the network as replicated
-fixed-point layers with per-layer activations (sigmoid / relu / softmax /
-identity).
+Same model coverage as the reference's
+``pymoose/pymoose/predictors/neural_network_predictor.py`` (Gemm /
+MatMul+Add graphs with per-layer sigmoid/relu/softmax/identity
+activations); the framework-layout quirks live in
+:func:`~.layers.stack_from_torch_or_tf` and the graph emission in
+:meth:`~.layers.DenseStack.build`, shared with the MLP family.
 """
 
 from enum import Enum
 
 import numpy as np
 
-import moose_tpu as pm
+import moose_tpu as pm  # noqa: F401 — public convenience re-export
 
-from . import onnx_proto
-from . import predictor
-from . import predictor_utils
+from . import predictor, predictor_utils
+from .layers import DenseLayer, DenseStack, stack_from_torch_or_tf
 
 
 class Activation(Enum):
@@ -25,109 +26,49 @@ class Activation(Enum):
     RELU = 4
 
 
+_KEY_TO_ENUM = {
+    "identity": Activation.IDENTITY,
+    "sigmoid": Activation.SIGMOID,
+    "softmax": Activation.SOFTMAX,
+    "relu": Activation.RELU,
+}
+_ENUM_TO_KEY = {v: k for k, v in _KEY_TO_ENUM.items()}
+
+
 class NeuralNetwork(predictor.Predictor):
     def __init__(self, weights, biases, activations):
         super().__init__()
-        self.weights = weights
-        self.biases = biases
-        self.activations = activations
-        self.n_classes = np.shape(biases[-1])[0]
+        self.weights = [np.asarray(w, dtype=np.float64) for w in weights]
+        self.biases = [
+            np.asarray(b, dtype=np.float64).ravel() for b in biases
+        ]
+        self.activations = list(activations)
+        self.n_classes = self.biases[-1].shape[0]
+        self._stack = DenseStack(tuple(
+            DenseLayer(w, b, _ENUM_TO_KEY[a])
+            for w, b, a in zip(
+                self.weights, self.biases, self.activations
+            )
+        ))
 
-    def apply_layer(self, input, i, fixedpoint_dtype):
-        w = self.fixedpoint_constant(
-            self.weights[i], plc=self.mirrored, dtype=fixedpoint_dtype
+    @classmethod
+    def from_onnx(cls, model_proto):
+        stack = stack_from_torch_or_tf(model_proto)
+        return cls(
+            [layer.weights for layer in stack.layers],
+            [layer.bias for layer in stack.layers],
+            [_KEY_TO_ENUM[layer.activation] for layer in stack.layers],
         )
-        b = self.fixedpoint_constant(
-            self.biases[i], plc=self.mirrored, dtype=fixedpoint_dtype
-        )
-        return pm.add(pm.dot(input, w), b)
-
-    def activation_fn(self, z, i):
-        activation = self.activations[i]
-        if activation == Activation.SIGMOID:
-            return pm.sigmoid(z)
-        if activation == Activation.RELU:
-            return pm.relu(z)
-        if activation == Activation.SOFTMAX:
-            return pm.softmax(z, axis=1, upmost_index=self.n_classes)
-        if activation == Activation.IDENTITY:
-            return z
-        raise ValueError("Invalid or unsupported activation function")
 
     def predictor_fn(self, x, fixedpoint_dtype):
-        for i in range(len(self.weights)):
-            x = self.apply_layer(x, i, fixedpoint_dtype)
-            x = self.activation_fn(x, i)
-        return x
+        return self._stack.build(
+            x, fixedpoint_dtype,
+            lambda v, dtype: self.fixedpoint_constant(
+                v, plc=self.mirrored, dtype=dtype
+            ),
+        )
 
     def __call__(
         self, x, fixedpoint_dtype=predictor_utils.DEFAULT_FIXED_DTYPE
     ):
         return self.predictor_fn(x, fixedpoint_dtype)
-
-    @classmethod
-    def from_onnx(cls, model_proto):
-        operations = predictor_utils.find_op_types_in_model_proto(model_proto)
-        activations = []
-        for i, op in enumerate(operations):
-            if op == "Sigmoid":
-                activations.append(Activation.SIGMOID)
-            elif op == "Softmax":
-                activations.append(Activation.SOFTMAX)
-            elif op == "Relu":
-                activations.append(Activation.RELU)
-            # pytorch: two adjacent Gemms -> implicit identity between them
-            if i > 0 and op == "Gemm" and operations[i - 1] == "Gemm":
-                activations.append(Activation.IDENTITY)
-            # tf keras: MatMul+Add pairs back to back -> implicit identity
-            if (
-                i > 2
-                and op == "Add"
-                and operations[i - 1] == "MatMul"
-                and operations[i - 2] == "Add"
-                and operations[i - 3] == "MatMul"
-            ):
-                activations.append(Activation.IDENTITY)
-
-        # pytorch names: {layer}.weight / {layer}.bias;
-        # tf2onnx names contain MatMul / BiasAdd
-        weights_data = predictor_utils.find_parameters_in_model_proto(
-            model_proto, ["weight", "MatMul"], enforce=False
-        )
-        biases_data = predictor_utils.find_parameters_in_model_proto(
-            model_proto, ["bias", "BiasAdd"], enforce=False
-        )
-
-        # pytorch Gemm stores W as (out, in) and computes x @ W^T
-        weights = [
-            onnx_proto.tensor_to_numpy(w).astype(np.float64).T
-            for w in weights_data
-        ]
-        biases = [
-            onnx_proto.tensor_to_numpy(b).astype(np.float64).ravel()
-            for b in biases_data
-        ]
-
-        if "tf" in model_proto.producer_name:
-            # tf2onnx lists parameters from last layer to first, and its
-            # MatMul weights are already (in, out): undo the blanket .T
-            weights = [w.T for w in weights[::-1]]
-            biases = biases[::-1]
-
-        n_features = predictor_utils.input_n_features(model_proto)
-        if n_features != weights[0].shape[0]:
-            raise ValueError(
-                f"In the ONNX file, the input shape has {n_features} "
-                "features and the shape of the weights for the first "
-                f"layer is: {weights[0].shape}. Validate you set "
-                "correctly the `initial_types` when converting "
-                "your model to ONNX."
-            )
-
-        # a final layer with no trailing activation node (e.g. a bare
-        # Gemm regressor head) contributes no entry above — pad with the
-        # identity so activations aligns with weights
-        while len(activations) < len(weights):
-            activations.append(Activation.IDENTITY)
-
-        return cls(weights, biases, activations)

@@ -1,34 +1,70 @@
-"""Predictor base class + AES input wrapper (reference:
-``pymoose/pymoose/predictors/predictor.py:6-85``).
+"""Predictor base class + AES input wrapper.
 
-A predictor owns the standard alice/bob/carole host placements plus the
-replicated and mirrored placements, and exposes ``predictor_fn`` /
-``__call__`` that build eDSL graphs for encrypted inference under 3-party
-replicated secret sharing.
+API surface matches the reference interface
+(``pymoose/pymoose/predictors/predictor.py:6-85``) so existing
+``@pm.computation`` graphs keep tracing unchanged; the implementation is
+this repo's own: placements come from a shared frozen context, the AES
+extension is a real mixin class composed by ``type()`` (not a closure-
+scoped subclass), and input validation raises typed errors instead of
+asserting.
 """
 
 import abc
+import dataclasses
 
 import moose_tpu as pm
 
 from . import predictor_utils as utils
 
 
+@dataclasses.dataclass(frozen=True)
+class PlacementContext:
+    """The standard 3-party layout every predictor computes under: three
+    named hosts, one replicated placement for the secret-shared compute,
+    one mirrored placement for public model constants."""
+
+    players: tuple
+    replicated: object
+    mirrored: object
+
+    @classmethod
+    def standard(cls) -> "PlacementContext":
+        players = tuple(
+            pm.host_placement(name) for name in ("alice", "bob", "carole")
+        )
+        return cls(
+            players=players,
+            replicated=pm.replicated_placement(
+                name="replicated", players=list(players)
+            ),
+            mirrored=pm.mirrored_placement(
+                name="mirrored", players=list(players)
+            ),
+        )
+
+
 class Predictor(metaclass=abc.ABCMeta):
     """Base class for the moose_tpu predictor interface."""
 
     def __init__(self):
-        (
-            (self.alice, self.bob, self.carole),
-            self.mirrored,
-            self.replicated,
-        ) = self._standard_replicated_placements()
+        ctx = PlacementContext.standard()
+        self._ctx = ctx
+        self.alice, self.bob, self.carole = ctx.players
+        self.replicated = ctx.replicated
+        self.mirrored = ctx.mirrored
+
+    @property
+    def host_placements(self):
+        return self._ctx.players
 
     @classmethod
     def fixedpoint_constant(cls, x, plc=None, dtype=utils.DEFAULT_FIXED_DTYPE):
         """Embed a constant and cast it to the working fixed-point dtype."""
-        x = pm.constant(x, dtype=pm.float64, placement=plc)
-        return pm.cast(x, dtype=dtype, placement=plc)
+        return pm.cast(
+            pm.constant(x, dtype=pm.float64, placement=plc),
+            dtype=dtype,
+            placement=plc,
+        )
 
     @classmethod
     def handle_output(
@@ -36,12 +72,7 @@ class Predictor(metaclass=abc.ABCMeta):
     ):
         """Pin a value to an output placement, casting to a plaintext dtype."""
         with prediction_handler:
-            result = pm.cast(prediction, dtype=output_dtype)
-        return result
-
-    @property
-    def host_placements(self):
-        return self.alice, self.bob, self.carole
+            return pm.cast(prediction, dtype=output_dtype)
 
     def predictor_factory(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
         """Standard plaintext-input computation: alice supplies x, bob
@@ -58,62 +89,65 @@ class Predictor(metaclass=abc.ABCMeta):
         return predictor
 
     def _standard_replicated_placements(self):
-        alice = pm.host_placement("alice")
-        bob = pm.host_placement("bob")
-        carole = pm.host_placement("carole")
-        replicated = pm.replicated_placement(
-            name="replicated", players=[alice, bob, carole]
-        )
-        mirrored = pm.mirrored_placement(
-            name="mirrored", players=[alice, bob, carole]
-        )
-        return (alice, bob, carole), mirrored, replicated
+        # kept for API compatibility with reference-era subclasses that
+        # call it directly
+        ctx = PlacementContext.standard()
+        return ctx.players, ctx.mirrored, ctx.replicated
+
+
+class AesInputMixin:
+    """Encrypted-input front end: the client uploads an AES-GCM
+    ciphertext, the key is secret-shared on the replicated placement, and
+    decryption happens under MPC (the plaintext never exists on any one
+    machine).  Composed onto a concrete predictor class by
+    :func:`AesWrapper`."""
+
+    def __call__(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
+        return self.aes_predictor_factory(fixedpoint_dtype)
+
+    @classmethod
+    def handle_aes_input(cls, aes_key, aes_data, decryptor):
+        if not isinstance(aes_data.vtype, pm.AesTensorType):
+            raise TypeError(
+                f"expected AesTensorType input, found {aes_data.vtype}"
+            )
+        if not aes_data.vtype.dtype.is_fixedpoint:
+            raise TypeError("AES tensor payload must be fixed-point")
+        if not isinstance(aes_key.vtype, pm.AesKeyType):
+            raise TypeError(
+                f"expected AesKeyType input, found {aes_key.vtype}"
+            )
+        with decryptor:
+            return pm.decrypt(aes_key, aes_data)
+
+    def aes_predictor_factory(
+        self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE
+    ):
+        @pm.computation
+        def predictor(
+            aes_data: pm.Argument(
+                self.alice,
+                vtype=pm.AesTensorType(dtype=fixedpoint_dtype),
+            ),
+            aes_key: pm.Argument(self.replicated, vtype=pm.AesKeyType()),
+        ):
+            x = self.handle_aes_input(
+                aes_key, aes_data, decryptor=self.replicated
+            )
+            with self.replicated:
+                pred = self.predictor_fn(x, fixedpoint_dtype)
+            return self.handle_output(pred, prediction_handler=self.bob)
+
+        return predictor
 
 
 def AesWrapper(inner_model_cls):
-    """Extend a predictor class with AES-encrypted input handling
-    (reference predictor.py:49-85): the client uploads an AES-CTR
-    ciphertext, the key is secret-shared on the replicated placement, and
-    decryption happens under MPC."""
-
-    class AesPredictor(inner_model_cls):
-        def __call__(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
-            return self.aes_predictor_factory(fixedpoint_dtype)
-
-        @classmethod
-        def handle_aes_input(cls, aes_key, aes_data, decryptor):
-            if not isinstance(aes_data.vtype, pm.AesTensorType):
-                raise TypeError(
-                    f"expected AesTensorType input, found {aes_data.vtype}"
-                )
-            if not aes_data.vtype.dtype.is_fixedpoint:
-                raise TypeError("AES tensor payload must be fixed-point")
-            if not isinstance(aes_key.vtype, pm.AesKeyType):
-                raise TypeError(
-                    f"expected AesKeyType input, found {aes_key.vtype}"
-                )
-            with decryptor:
-                return pm.decrypt(aes_key, aes_data)
-
-        def aes_predictor_factory(
-            self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE
-        ):
-            @pm.computation
-            def predictor(
-                aes_data: pm.Argument(
-                    self.alice,
-                    vtype=pm.AesTensorType(dtype=fixedpoint_dtype),
-                ),
-                aes_key: pm.Argument(self.replicated, vtype=pm.AesKeyType()),
-            ):
-                x = self.handle_aes_input(
-                    aes_key, aes_data, decryptor=self.replicated
-                )
-                with self.replicated:
-                    pred = self.predictor_fn(x, fixedpoint_dtype)
-                return self.handle_output(pred, prediction_handler=self.bob)
-
-            return predictor
-
-    AesPredictor.__name__ = f"Aes{inner_model_cls.__name__}"
-    return AesPredictor
+    """Extend a predictor class with AES-encrypted input handling: the
+    mixin's methods take precedence over the inner class's ``__call__``
+    while everything else (from_onnx, predictor_fn, weights) is
+    inherited unchanged."""
+    return type(
+        f"Aes{inner_model_cls.__name__}",
+        (AesInputMixin, inner_model_cls),
+        {},
+    )

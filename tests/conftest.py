@@ -23,10 +23,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: the jit-parametrized acceptance tests
-# compile large protocol graphs (softmax/sqrt chains are ~2 min of XLA CPU
-# compile each); caching them across test runs cuts the suite from ~23 min
-# to a few minutes on a warm cache.  Override with MOOSE_TPU_COMPILE_CACHE
-# (empty string disables).
+# compile large protocol graphs; caching across test runs keeps warm
+# suites fast.  (Cold compiles are bounded by segmented jit — big graphs
+# auto-route through lowering and compile as MOOSE_TPU_JIT_SEGMENT-sized
+# XLA programs, each of which caches here independently.)  Override with
+# MOOSE_TPU_COMPILE_CACHE (empty string disables).
 _cache_dir = os.environ.get(
     "MOOSE_TPU_COMPILE_CACHE",
     os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"),

@@ -352,3 +352,93 @@ def test_elk_compiler_compile_then_evaluate_compiled():
     ).values()
     np.testing.assert_allclose(val, x @ w, atol=1e-4)
     assert "evaluate_compiled" in rt.last_timings
+
+
+def test_segmented_jit_matches_eager(monkeypatch):
+    """Graphs above MOOSE_TPU_JIT_SEGMENT host-ops split into separately
+    jitted segments (XLA compile is superlinear in program size); values
+    crossing a boundary — replicated shares, PRF keys, Send/Receive
+    rendezvous — must flow losslessly and match the eager walk."""
+    monkeypatch.setenv("MOOSE_TPU_JIT_SEGMENT", "40")
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            d = pm.dot(xf, wf)  # ~170 host ops -> several 40-op segments
+        with carole:
+            out = pm.cast(d, dtype=pm.float64)
+        return out
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(3, 3))
+    w = rng.normal(size=(3, 3))
+
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.edsl import tracer as _tracer
+    from moose_tpu.execution.physical import execute_physical
+
+    compiled = compile_computation(
+        _tracer.trace(comp), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments({"x": x, "w": w}),
+    )
+    assert len(compiled.operations) > 40  # really exercises >1 segment
+    got = execute_physical(
+        compiled, {}, {"x": x, "w": w}, use_jit=True
+    )
+    (got_v,) = got.values()
+    ref = execute_physical(
+        compiled, {}, {"x": x, "w": w}, use_jit=False
+    )
+    (ref_v,) = ref.values()
+    np.testing.assert_allclose(got_v, x @ w, atol=2e-4)
+    np.testing.assert_allclose(ref_v, x @ w, atol=2e-4)
+
+
+def test_auto_lowering_routes_heavy_replicated_graphs():
+    """Under jit, protocol-heavy graphs (a secure softmax is ~10k host
+    ops) route through the lowering pipeline so the physical executor
+    can compile them as bounded segments; small graphs stay on the
+    fused logical path."""
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def heavy(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(24, 40))
+        with rep:
+            z = pm.softmax(xf, axis=1, upmost_index=3)
+        with carole:
+            out = pm.cast(z, dtype=pm.float64)
+        return out
+
+    @pm.computation
+    def light(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(24, 40))
+        with rep:
+            z = pm.add(xf, xf)
+        with carole:
+            out = pm.cast(z, dtype=pm.float64)
+        return out
+
+    from moose_tpu.edsl import tracer as _tracer
+
+    rt = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
+    assert rt._auto_lower_passes(_tracer.trace(heavy)) is not None
+    assert rt._auto_lower_passes(_tracer.trace(light)) is None
