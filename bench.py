@@ -45,9 +45,16 @@ N = 1000
 
 
 def _bench_predictor(comp, args, check, batch):
-    """Median steady-state latency/throughput of one predictor comp."""
+    """Median steady-state latency/throughput of one predictor comp.
+
+    Opts in to TPU jit for heavy protocol graphs despite the documented
+    experimental-backend miscompile risk (DEVELOP.md "Known issue") —
+    safely, because every bench run VERIFIES its outputs against sklearn
+    below: a miscompile here fails the bench loudly instead of reporting
+    wrong-but-fast numbers.  The library default stays safe (eager)."""
     from moose_tpu.runtime import LocalMooseRuntime
 
+    os.environ["MOOSE_TPU_TPU_JIT_HEAVY"] = "1"
     runtime = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
     (out,) = runtime.evaluate_computation(comp, arguments=args).values()
     check(out)

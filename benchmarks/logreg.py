@@ -262,7 +262,6 @@ def main():
 
     print(json.dumps({
         "bench": "logreg_train",
-        "trajectory_max_abs_err": traj_err,
         "batch_size": batch_size,
         "n_iter": n_batches,
         "median_s": statistics.median(times),

@@ -1,0 +1,1 @@
+"""Host-side cryptographic primitives (reference-compatibility paths)."""

@@ -1,0 +1,87 @@
+"""AES-128-CTR pseudo-random generator matching the reference's
+``aes_prng::AesRng`` construction (``host/prim.rs:5`` imports it; the
+crate generates the keystream as AES-128 encryptions of an incrementing
+128-bit little-endian counter starting at zero, consumed as
+little-endian words).
+
+The block cipher is the repo's FIPS-197-validated numpy AES
+(``dialects/aes.py``); this module only adds the counter-mode stream and
+the draw order the reference's sampling kernels use
+(``host/ops.rs:1959-2040``): ``next_u64`` consumes 8 keystream bytes LE;
+ring128 elements draw HIGH limb first; bits consume one keystream byte's
+low bit per draw (``get_bit``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dialects.aes import aes128_encrypt_block_np
+
+
+class AesCtrRng:
+    def __init__(self, seed: bytes):
+        if len(seed) != 16:
+            raise ValueError("AesRng seed must be 16 bytes")
+        self._key = bytes(seed)
+        self._counter = 0
+        self._buf = b""
+        self._pos = 0
+
+    def _refill(self, min_bytes: int) -> None:
+        need = max(min_bytes - (len(self._buf) - self._pos), 0)
+        blocks = (need + 15) // 16
+        out = bytearray(self._buf[self._pos:])
+        for _ in range(max(blocks, 1)):
+            ctr_bytes = self._counter.to_bytes(16, "little")
+            out += aes128_encrypt_block_np(self._key, ctr_bytes)
+            self._counter += 1
+        self._buf = bytes(out)
+        self._pos = 0
+
+    def next_bytes(self, n: int) -> bytes:
+        if len(self._buf) - self._pos < n:
+            self._refill(n)
+        out = self._buf[self._pos:self._pos + n]
+        self._pos += n
+        return out
+
+    def next_u64(self) -> int:
+        return int.from_bytes(self.next_bytes(8), "little")
+
+    def get_bit(self) -> int:
+        return self.next_bytes(1)[0] & 1
+
+    # -- bulk draws in the reference's element orders -------------------
+
+    def uniform_u64(self, size: int) -> np.ndarray:
+        raw = self.next_bytes(8 * size)
+        return np.frombuffer(raw, dtype="<u8").astype(np.uint64)
+
+    def uniform_u128(self, size: int):
+        """(lo, hi) u64 arrays; the reference draws the HIGH limb first
+        per element ((next_u64 << 64) + next_u64, host/ops.rs:2000)."""
+        raw = np.frombuffer(
+            self.next_bytes(16 * size), dtype="<u8"
+        ).reshape(size, 2)
+        return (
+            raw[:, 1].astype(np.uint64).copy(),  # second draw = low
+            raw[:, 0].astype(np.uint64).copy(),  # first draw = high
+        )
+
+    def bits(self, size: int) -> np.ndarray:
+        raw = np.frombuffer(self.next_bytes(size), dtype=np.uint8)
+        return (raw & 1).astype(np.uint8)
+
+
+def derive_seed(key_bytes: bytes, session_id: str,
+                sync_key: bytes) -> bytes:
+    """The reference's DeriveSeed kernel (host/prim.rs:123-147):
+    blake3-derive a hashing key from the PRF key, then keyed-hash
+    ``session_id_bytes(16) || sync_key(16)`` and take 16 output bytes."""
+    from .blake3 import derive_key, keyed_hash
+
+    derived = derive_key("Derive Seed", bytes(key_bytes))
+    sid = session_id.encode()[:16].ljust(16, b"\x00")
+    sk = bytes(sync_key)[:16].ljust(16, b"\x00")
+    return keyed_hash(derived, sid + sk, out_len=16)

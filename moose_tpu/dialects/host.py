@@ -122,10 +122,26 @@ def key_gen(plc: str, key_words) -> HostPrfKey:
     return HostPrfKey(jnp.asarray(key_words, dtype=jnp.uint32), plc)
 
 
-def derive_seed(key: HostPrfKey, sync_key: bytes, plc: str) -> HostSeed:
-    """Derive a 128-bit seed from a PRF key and a static nonce
-    (reference: blake3 keyed hash, host/prim.rs:123; here one PRF draw
-    keyed by a key/nonce mix — see ring.mix_seed)."""
+def derive_seed(key: HostPrfKey, sync_key: bytes, plc: str,
+                session_id: str = "") -> HostSeed:
+    """Derive a 128-bit seed from a PRF key and a static nonce.
+
+    Default impls use one PRF draw keyed by a key/nonce mix (see
+    ring.mix_seed); under ``set_prf_impl("aes-ctr")`` this is the
+    reference's exact construction — blake3 derive_key("Derive Seed",
+    key) then a keyed hash of session_id || sync_key
+    (host/prim.rs:123-147) — so seeds match pymoose bit for bit given
+    the same key, session id, and sync key."""
+    if ring.get_prf_impl() == "aes-ctr":
+        from ..crypto.aes_prng import derive_seed as _reference_derive
+
+        key_bytes = ring._concrete_seed_bytes(key.value)
+        seed = _reference_derive(key_bytes, session_id, sync_key)
+        import jax.numpy as jnp
+
+        return HostSeed(
+            jnp.asarray(np.frombuffer(seed, dtype=np.uint32)), plc
+        )
     words = np.frombuffer(sync_key[:16].ljust(16, b"\0"), dtype=np.uint32)
     return HostSeed(ring.mix_seed(key.value, words), plc)
 
@@ -145,8 +161,17 @@ def sample_bits_seeded(
 
 
 def sample_bit_tensor_seeded(shp: HostShape, seed: HostSeed, plc: str) -> HostBitTensor:
+    shape = tuple(shp.value)
+    if ring.get_prf_impl() == "aes-ctr":
+        from ..crypto.aes_prng import AesCtrRng
+
+        rng = AesCtrRng(ring._concrete_seed_bytes(seed.value))
+        n = int(np.prod(shape)) if shape else 1
+        return HostBitTensor(
+            jnp.asarray(rng.bits(n).reshape(shape)), plc
+        )
     key = ring._key_from_seed(seed.value)
-    bits = jax.random.bits(key, tuple(shp.value), dtype=jnp.uint8) & jnp.uint8(1)
+    bits = jax.random.bits(key, shape, dtype=jnp.uint8) & jnp.uint8(1)
     return HostBitTensor(bits, plc)
 
 

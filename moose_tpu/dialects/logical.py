@@ -108,6 +108,13 @@ def to_rep(sess, rep: ReplicatedPlacement, v):
         h = to_host(sess, rep.owners[0], v)
         return to_rep(sess, rep, h)
     if isinstance(v, HostTensor):
+        if v.dtype is not None and v.dtype.is_integer:
+            # uint64 host tensor -> ring64 (scale-0 encode is an exact
+            # integer lift below 2^53; matches the reference where the
+            # integer dialect's HostT IS HostRing64Tensor,
+            # integer/mod.rs:12-15) then share
+            ring64 = sess.ring_fixedpoint_encode(v.plc, v, 0, 64)
+            return rep_ops.share(sess, rep, ring64)
         raise TypeError(
             "cannot share a plaintext float tensor; cast to a fixed dtype "
             "first (reference requires FixedpointEncode before Share)"
@@ -671,6 +678,21 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
             return _rep_public_binop(sess, rep, yr, x, kind, right=False)
         xr = to_rep(sess, rep, x)
         yr = to_rep(sess, rep, y)
+        if isinstance(xr, RepTensor) and isinstance(yr, RepTensor):
+            # secret-shared uint64 (integer dialect,
+            # reference integer/mod.rs:12-15): bare ring shares with NO
+            # fixed-point scale — plain wrapping ring arithmetic, no
+            # truncation (mul/dot cost one reshare round)
+            fn = {
+                "Add": rep_ops.add, "Sub": rep_ops.sub,
+                "Mul": rep_ops.mul, "Dot": rep_ops.dot,
+            }.get(kind)
+            if fn is None:
+                raise NotImplementedError(
+                    "Div on secret uint64 is undefined (ring division); "
+                    "cast to a fixed dtype first"
+                )
+            return fn(sess, rep, xr, yr)
         fn = {"Add": fx.add, "Sub": fx.sub, "Mul": fx.mul, "Dot": fx.dot,
               "Div": fx.div}[kind]
         return fn(sess, rep, xr, yr)

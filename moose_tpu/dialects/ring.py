@@ -270,18 +270,25 @@ def equal_bits(lo1, hi1, lo2, hi2):
 # "threefry" (a real reduced-Threefish PRF) for anything deployed across
 # trust domains.  Distributed runtimes call ``require_strong_prf()`` and
 # refuse to run on rbg unless MOOSE_TPU_ALLOW_WEAK_PRF=1 is set explicitly.
+_PRF_IMPLS = ("rbg", "threefry", "aes-ctr")
 _PRF_IMPL = _os.environ.get("MOOSE_TPU_PRF", "rbg")
-if _PRF_IMPL not in ("rbg", "threefry"):
-    raise ValueError(f"MOOSE_TPU_PRF must be 'rbg' or 'threefry', got {_PRF_IMPL!r}")
+if _PRF_IMPL not in _PRF_IMPLS:
+    raise ValueError(
+        f"MOOSE_TPU_PRF must be one of {_PRF_IMPLS}, got {_PRF_IMPL!r}"
+    )
 
 
 def set_prf_impl(name: str) -> None:
+    """Select the PRF: "rbg" (fast Philox; local simulation), "threefry"
+    (cryptographic, jittable), or "aes-ctr" (the REFERENCE's construction
+    — blake3 seed derivation + AES-128-CTR expansion on the host, for
+    bit-compatibility checks against pymoose; eager-only)."""
     global _PRF_IMPL
-    if name not in ("rbg", "threefry"):
+    if name not in _PRF_IMPLS:
         from ..errors import ConfigurationError
 
         raise ConfigurationError(
-            f"PRF impl must be 'rbg' or 'threefry', got {name!r}"
+            f"PRF impl must be one of {_PRF_IMPLS}, got {name!r}"
         )
     _PRF_IMPL = name
 
@@ -298,6 +305,7 @@ def require_strong_prf(context: str) -> None:
     three parties live in one trust domain (one XLA program) but is an
     unsafe source of share masks across genuinely distrusting parties.
     """
+    # threefry and aes-ctr are both real PRFs; only rbg is gated
     if _PRF_IMPL == "rbg" and _os.environ.get(
         "MOOSE_TPU_ALLOW_WEAK_PRF"
     ) != "1":
@@ -336,9 +344,37 @@ def mix_seed(seed_u32x4, nonce_u32x4):
     return jax.random.bits(key, (4,), dtype=jnp.uint32)
 
 
+def _concrete_seed_bytes(seed_u32x4) -> bytes:
+    """Seed words -> 16 bytes; rejects tracers (the aes-ctr PRF runs on
+    the host and cannot live inside a jitted program)."""
+    import jax.core as _core
+
+    if isinstance(seed_u32x4, _core.Tracer):
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            "the aes-ctr PRF is host-side (numpy blake3 + AES) and "
+            "cannot run under jit; evaluate eagerly (MOOSE_TPU_JIT=0 / "
+            "use_jit=False) when using set_prf_impl('aes-ctr')"
+        )
+    return np.asarray(seed_u32x4, dtype=np.uint32).tobytes()
+
+
 def sample_uniform_seeded(shape, seed_u32x4, width: int):
-    key = _key_from_seed(seed_u32x4)
     shape = tuple(int(s) for s in shape)
+    if _PRF_IMPL == "aes-ctr":
+        from ..crypto.aes_prng import AesCtrRng
+
+        rng = AesCtrRng(_concrete_seed_bytes(seed_u32x4))
+        n = int(np.prod(shape)) if shape else 1
+        if width == 64:
+            return jnp.asarray(rng.uniform_u64(n).reshape(shape)), None
+        lo, hi = rng.uniform_u128(n)
+        return (
+            jnp.asarray(lo.reshape(shape)),
+            jnp.asarray(hi.reshape(shape)),
+        )
+    key = _key_from_seed(seed_u32x4)
     if width == 64:
         return jax.random.bits(key, shape, dtype=U64), None
     # one draw for both limbs (avoids key splits, which are expensive for
@@ -348,8 +384,16 @@ def sample_uniform_seeded(shape, seed_u32x4, width: int):
 
 
 def sample_bits_seeded(shape, seed_u32x4, width: int):
-    key = _key_from_seed(seed_u32x4)
     shape = tuple(int(s) for s in shape)
+    if _PRF_IMPL == "aes-ctr":
+        from ..crypto.aes_prng import AesCtrRng
+
+        rng = AesCtrRng(_concrete_seed_bytes(seed_u32x4))
+        n = int(np.prod(shape)) if shape else 1
+        lo = jnp.asarray(rng.bits(n).reshape(shape).astype(np.uint64))
+        hi = jnp.zeros_like(lo) if width == 128 else None
+        return lo, hi
+    key = _key_from_seed(seed_u32x4)
     bits = jax.random.bits(key, shape, dtype=jnp.uint8) & jnp.uint8(1)
     lo = bits.astype(U64)
     hi = jnp.zeros_like(lo) if width == 128 else None
@@ -843,8 +887,22 @@ def fixedpoint_encode(x, frac_precision: int, width: int):
     """Encode floats into the ring: round(x * 2^f) two's complement.
 
     Exactness caveat shared with the reference: the scaled value must fit in
-    float64's 53-bit mantissa to be exact.
+    float64's 53-bit mantissa to be exact.  Integer inputs at scale 0
+    (the secret-uint64 integer dialect) skip the float detour entirely —
+    full 64-bit values lift losslessly.
     """
+    if frac_precision == 0 and jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.integer
+    ):
+        lo = jnp.asarray(x).astype(U64)
+        if width == 64:
+            return lo, None
+        # sign-extend signed inputs into the high limb
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.signedinteger):
+            hi = (jnp.asarray(x).astype(jnp.int64) >> np.int64(63)).astype(U64)
+        else:
+            hi = jnp.zeros_like(lo)
+        return lo, hi
     scaled = jnp.round(x.astype(jnp.float64) * (2.0 ** frac_precision))
     si = scaled.astype(jnp.int64)
     lo = si.astype(U64)

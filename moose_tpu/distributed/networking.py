@@ -110,14 +110,18 @@ class _CellStore:
     _MAX_ACTIVITY = 4096
 
     def __init__(self):
+        from collections import OrderedDict
+
         self._lock = threading.Lock()
         self._values: dict = {}
         self._events: dict = {}
         # per-session arrival wakeups: each session's receive poller
         # sleeps on ITS event — a shared one would let one session's
         # poller swallow another's wakeup (clear/wait race), degrading
-        # concurrent sessions to the fallback poll interval
-        self._activity: dict = {}
+        # concurrent sessions to the fallback poll interval.  LRU so a
+        # busy long-lived session is never evicted by short-session
+        # churn (every touch refreshes recency).
+        self._activity: "OrderedDict[str, threading.Event]" = OrderedDict()
 
     def activity_for(self, session_id: str):
         with self._lock:
@@ -125,7 +129,9 @@ class _CellStore:
             if ev is None:
                 ev = self._activity[session_id] = threading.Event()
                 while len(self._activity) > self._MAX_ACTIVITY:
-                    self._activity.pop(next(iter(self._activity)))
+                    self._activity.popitem(last=False)
+            else:
+                self._activity.move_to_end(session_id)
             return ev
 
     def put(self, key: str, value):

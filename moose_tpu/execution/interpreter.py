@@ -195,6 +195,24 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
             env[name] = logical.execute_op(sess, comp, op, args)
 
 
+def heavy_jit_gate(n_ops: int, use_jit: bool) -> bool:
+    """The effective use_jit after the experimental-TPU guard: jitted
+    protocol graphs above the segment limit miscompile for some session
+    keys on the TPU backend (see DEVELOP.md "Known issue"); every
+    executor entry point — not just the auto-lowering route — must make
+    the same call, so it lives here.  MOOSE_TPU_TPU_JIT_HEAVY=1
+    re-enables (debugging)."""
+    if not use_jit or n_ops <= _segment_limit():
+        return use_jit
+    import os
+
+    if os.environ.get("MOOSE_TPU_TPU_JIT_HEAVY") == "1":
+        return use_jit
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def _segment_limit() -> int:
     """Above this many ops a jitted plan is split into separately-jitted
     segments: XLA compile time is superlinear in program size (measured
@@ -264,10 +282,8 @@ def _build_segmented_plan(comp_ref, order, static_env, dynamic_names):
         lambda n: comp.operations[n].inputs,
         _segment_limit(),
     )
-    dyn_of = [
-        [n for n in names if n in set(dynamic_names)]
-        for names in chunks
-    ]
+    dyn_set = set(dynamic_names)
+    dyn_of = [[n for n in names if n in dyn_set] for names in chunks]
 
     def make_seg(si, names):
         outs = out_names[si]
@@ -428,6 +444,7 @@ class Interpreter:
         from .. import telemetry
 
         arguments = arguments or {}
+        use_jit = heavy_jit_gate(len(comp.operations), use_jit)
         per_comp = self._cache.get(comp)
         if per_comp is None:
             per_comp = self._cache[comp] = {}

@@ -460,7 +460,10 @@ class PhysicalInterpreter:
         arguments: Optional[dict] = None,
         use_jit: bool = True,
     ) -> dict:
+        from .interpreter import heavy_jit_gate
+
         arguments = arguments or {}
+        use_jit = heavy_jit_gate(len(comp.operations), use_jit)
         per_comp = self._cache.get(comp)
         if per_comp is None:
             per_comp = self._cache[comp] = {}

@@ -87,7 +87,9 @@ class EagerSession:
         return HostPrfKey(ring.mix_seed(self._master, nonce), plc)
 
     def derive_seed(self, plc: str, key: HostPrfKey, sync_key: bytes) -> HostSeed:
-        return host.derive_seed(key, sync_key, plc)
+        return host.derive_seed(
+            key, sync_key, plc, session_id=self.session_id
+        )
 
     def sample_uniform_seeded(self, plc, shp, seed, width: int):
         return host.sample_uniform_seeded(shp, seed, width, plc)

@@ -21,18 +21,6 @@ from .edsl import tracer
 from .execution.interpreter import Interpreter
 
 
-def _tpu_heavy_jit_unsafe() -> bool:
-    """True when jitting LARGE protocol graphs must be avoided on the
-    current backend (experimental-TPU miscompile; see the call site)."""
-    import os
-
-    if os.environ.get("MOOSE_TPU_TPU_JIT_HEAVY") == "1":
-        return False
-    import jax
-
-    return jax.default_backend() == "tpu"
-
-
 def _lift_computation(computation, arguments):
     if isinstance(computation, edsl_base.AbstractComputation):
         computation = tracer.trace(computation)
@@ -136,17 +124,9 @@ class LocalMooseRuntime:
             # as bounded segments (results are identical — the compiler
             # tests pin lowered-matches-eager)
             compiler_passes = self._auto_lower_passes(computation)
-            if compiler_passes is not None and _tpu_heavy_jit_unsafe():
-                # KNOWN ISSUE (see DEVELOP.md): on the experimental TPU
-                # backend, jitted protocol graphs of this size compute
-                # key-value-dependent wrong results (eager per-op
-                # execution of the SAME lowered graph is exact; CPU is
-                # exact both ways; single ops and the bench graphs are
-                # exact).  Until the miscompile is isolated, heavy
-                # graphs run the lowered graph eagerly on TPU —
-                # correctness over speed.  Re-enable with
-                # MOOSE_TPU_TPU_JIT_HEAVY=1 (for debugging).
-                use_jit = False
+            # the TPU heavy-graph jit guard (DEVELOP.md "Known issue")
+            # lives in the EXECUTORS (interpreter.heavy_jit_gate), so it
+            # also covers evaluate_compiled and explicit compiler_passes
         if compiler_passes is not None:
             # explicit pass pipeline: lower to the host-level graph and run
             # it through the physical executor (the reference's LocalRuntime

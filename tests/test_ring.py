@@ -209,3 +209,28 @@ def test_matmul128_int8_i32_diag_boundary(k):
         ring.set_matmul_strategy(None)
     got = as_int128(lo, hi)
     np.testing.assert_array_equal(got, expected)
+
+
+def test_integer_encode_is_exact_beyond_float_mantissa():
+    """Scale-0 encode of integer inputs must NOT take the float64 detour:
+    secret-uint64 sharing relies on lossless lifts for values >= 2^53."""
+    import numpy as np
+
+    from moose_tpu.dialects import ring
+
+    x = np.array([2**53 + 1, 2**63 + 5, 0, 2**64 - 1], dtype=np.uint64)
+    lo, hi = ring.fixedpoint_encode(x, 0, 64)
+    np.testing.assert_array_equal(np.asarray(lo), x)
+    assert hi is None
+    lo, hi = ring.fixedpoint_encode(x, 0, 128)
+    np.testing.assert_array_equal(np.asarray(lo), x)
+    np.testing.assert_array_equal(np.asarray(hi), np.zeros_like(x))
+    # signed inputs sign-extend into the high limb
+    s = np.array([-1, -(2**40)], dtype=np.int64)
+    lo, hi = ring.fixedpoint_encode(s, 0, 128)
+    np.testing.assert_array_equal(
+        np.asarray(lo), s.astype(np.uint64)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(hi), np.full(2, 2**64 - 1, dtype=np.uint64)
+    )
