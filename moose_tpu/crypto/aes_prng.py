@@ -16,7 +16,52 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..dialects.aes import aes128_encrypt_block_np
+from ..dialects.aes import RCON, SBOX, aes128_encrypt_block_np  # noqa: F401
+
+
+def _key_schedule(key: bytes) -> list:
+    """AES-128 round keys (44 words / 11 round keys) — computed ONCE per
+    RNG: the per-block schedule recomputation would dominate keystream
+    generation for an unchanging key."""
+    def sub_word(w):
+        return [int(SBOX[b]) for b in w]
+
+    words = [list(key[4 * i:4 * i + 4]) for i in range(4)]
+    for i in range(4, 44):
+        t = list(words[i - 1])
+        if i % 4 == 0:
+            t = sub_word(t[1:] + t[:1])
+            t[0] ^= RCON[i // 4 - 1]
+        words.append([a ^ b for a, b in zip(words[i - 4], t)])
+    return [sum(words[4 * r:4 * r + 4], []) for r in range(11)]
+
+
+def _encrypt_blocks(round_keys, blocks: np.ndarray) -> np.ndarray:
+    """Vectorized AES-128 over an (n, 16) uint8 block array with a
+    precomputed schedule — numpy table lookups, one pass for the whole
+    batch instead of a python loop per block."""
+    from ..dialects.aes import _shift_rows_perm, gmul
+
+    sbox = np.asarray(SBOX, dtype=np.uint8)
+    perm = np.asarray(_shift_rows_perm(), dtype=np.int64)
+    g2 = np.asarray([gmul(2, b) for b in range(256)], dtype=np.uint8)
+    g3 = np.asarray([gmul(3, b) for b in range(256)], dtype=np.uint8)
+    rks = [np.asarray(rk, dtype=np.uint8) for rk in round_keys]
+
+    state = blocks ^ rks[0]
+    for r in range(1, 10):
+        state = sbox[state][:, perm]
+        # MixColumns on column-major state: bytes 4c..4c+3 are column c
+        s = state.reshape(-1, 4, 4)
+        out = (
+            g2[s]
+            ^ g3[np.roll(s, -1, axis=2)]
+            ^ np.roll(s, -2, axis=2)
+            ^ np.roll(s, -3, axis=2)
+        )
+        state = out.reshape(-1, 16) ^ rks[r]
+    state = sbox[state][:, perm] ^ rks[10]
+    return state
 
 
 class AesCtrRng:
@@ -24,19 +69,22 @@ class AesCtrRng:
         if len(seed) != 16:
             raise ValueError("AesRng seed must be 16 bytes")
         self._key = bytes(seed)
+        self._round_keys = _key_schedule(self._key)
         self._counter = 0
         self._buf = b""
         self._pos = 0
 
     def _refill(self, min_bytes: int) -> None:
         need = max(min_bytes - (len(self._buf) - self._pos), 0)
-        blocks = (need + 15) // 16
-        out = bytearray(self._buf[self._pos:])
-        for _ in range(max(blocks, 1)):
-            ctr_bytes = self._counter.to_bytes(16, "little")
-            out += aes128_encrypt_block_np(self._key, ctr_bytes)
-            self._counter += 1
-        self._buf = bytes(out)
+        blocks = max((need + 15) // 16, 1)
+        counters = np.zeros((blocks, 16), dtype=np.uint8)
+        for i in range(blocks):
+            counters[i] = np.frombuffer(
+                (self._counter + i).to_bytes(16, "little"), dtype=np.uint8
+            )
+        self._counter += blocks
+        ks = _encrypt_blocks(self._round_keys, counters)
+        self._buf = bytes(self._buf[self._pos:]) + ks.tobytes()
         self._pos = 0
 
     def next_bytes(self, n: int) -> bytes:

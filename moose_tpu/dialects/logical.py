@@ -678,7 +678,17 @@ def _execute_rep(sess, comp, op, plc: ReplicatedPlacement, args):
             return _rep_public_binop(sess, rep, yr, x, kind, right=False)
         xr = to_rep(sess, rep, x)
         yr = to_rep(sess, rep, y)
-        if isinstance(xr, RepTensor) and isinstance(yr, RepTensor):
+        bare_x = isinstance(xr, RepTensor)
+        bare_y = isinstance(yr, RepTensor)
+        if bare_x != bare_y:
+            from ..errors import TypeMismatchError
+
+            raise TypeMismatchError(
+                f"{kind} mixes a secret integer (bare ring shares) with "
+                "a secret fixed-point tensor; cast one side first "
+                f"(got {type(xr).__name__} and {type(yr).__name__})"
+            )
+        if bare_x and bare_y:
             # secret-shared uint64 (integer dialect,
             # reference integer/mod.rs:12-15): bare ring shares with NO
             # fixed-point scale — plain wrapping ring arithmetic, no

@@ -156,3 +156,83 @@ def test_aes_ctr_rejects_jit():
             jax.jit(f)(np.zeros(4, np.uint32))
     finally:
         ring.set_prf_impl("rbg")
+
+
+def test_distributed_workers_under_aes_ctr_prf():
+    """The reference-PRF construction runs across role-filtered workers
+    too (workers execute eagerly, so the host-side blake3/AES path
+    composes with the real Send/Receive machinery): a 3-worker secure
+    dot under aes-ctr reveals the right value."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import moose_tpu as pm
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.distributed.networking import LocalNetworking
+    from moose_tpu.distributed.worker import execute_role
+    from moose_tpu.edsl import tracer
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(2, 3))
+    w = rng.normal(size=(3, 1))
+    args = {"x": x, "w": w}
+    compiled = compile_computation(
+        tracer.trace(comp), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+
+    ring.set_prf_impl("aes-ctr")
+    try:
+        net = LocalNetworking()
+        results, errors = {}, {}
+
+        def work(identity):
+            try:
+                results[identity] = execute_role(
+                    compiled, identity, {}, args, net,
+                    session_id="aes-ctr-dist", timeout=60.0,
+                )
+            except Exception as e:  # surfaced below
+                errors[identity] = e
+
+        threads = [
+            threading.Thread(target=work, args=(i,), daemon=True)
+            for i in ("alice", "bob", "carole")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        outs = {
+            k: v for r in results.values()
+            for k, v in r["outputs"].items()
+        }
+        (val,) = outs.values()
+        np.testing.assert_allclose(val, x @ w, atol=1e-5)
+    finally:
+        ring.set_prf_impl("rbg")
