@@ -16,7 +16,14 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..dialects.aes import RCON, SBOX, aes128_encrypt_block_np  # noqa: F401
+from ..dialects.aes import RCON, SBOX, _shift_rows_perm, gmul
+
+# hoisted lookup tables: building them per keystream refill would
+# dominate generation (512 interpreted gmul() calls each time)
+_SBOX_NP = np.asarray(SBOX, dtype=np.uint8)
+_PERM_NP = np.asarray(_shift_rows_perm(), dtype=np.int64)
+_G2_NP = np.asarray([gmul(2, b) for b in range(256)], dtype=np.uint8)
+_G3_NP = np.asarray([gmul(3, b) for b in range(256)], dtype=np.uint8)
 
 
 def _key_schedule(key: bytes) -> list:
@@ -40,13 +47,8 @@ def _encrypt_blocks(round_keys, blocks: np.ndarray) -> np.ndarray:
     """Vectorized AES-128 over an (n, 16) uint8 block array with a
     precomputed schedule — numpy table lookups, one pass for the whole
     batch instead of a python loop per block."""
-    from ..dialects.aes import _shift_rows_perm, gmul
-
-    sbox = np.asarray(SBOX, dtype=np.uint8)
-    perm = np.asarray(_shift_rows_perm(), dtype=np.int64)
-    g2 = np.asarray([gmul(2, b) for b in range(256)], dtype=np.uint8)
-    g3 = np.asarray([gmul(3, b) for b in range(256)], dtype=np.uint8)
-    rks = [np.asarray(rk, dtype=np.uint8) for rk in round_keys]
+    sbox, perm, g2, g3 = _SBOX_NP, _PERM_NP, _G2_NP, _G3_NP
+    rks = round_keys
 
     state = blocks ^ rks[0]
     for r in range(1, 10):
@@ -69,7 +71,10 @@ class AesCtrRng:
         if len(seed) != 16:
             raise ValueError("AesRng seed must be 16 bytes")
         self._key = bytes(seed)
-        self._round_keys = _key_schedule(self._key)
+        self._round_keys = [
+            np.asarray(rk, dtype=np.uint8)
+            for rk in _key_schedule(self._key)
+        ]
         self._counter = 0
         self._buf = b""
         self._pos = 0

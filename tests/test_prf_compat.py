@@ -165,10 +165,6 @@ def test_distributed_workers_under_aes_ctr_prf():
     dot under aes-ctr reveals the right value."""
     import threading
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
     import moose_tpu as pm
     from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
     from moose_tpu.compilation.lowering import arg_specs_from_arguments
