@@ -170,6 +170,17 @@ def bench_logreg(runtime, pm, batch_size, n_iter, iters):
         for _ in range(n_iter):
             outputs, _ = runtime.evaluate_computation(comp, args)
         times.append(time.perf_counter() - t0)
+    # Gate the revealed weights on the plaintext trajectory (each run
+    # re-feeds w_0 = 0, so every run is the same single momentum step):
+    # wrong-but-fast numbers must not be publishable (ADVICE r3).
+    w_ref = lr._plaintext_sgd_momentum(
+        x, y, batch_size, 1, lr.LEARNING_RATE, lr.MOMENTUM
+    )
+    w_out = next(
+        np.asarray(v) for v in outputs.values()
+        if np.asarray(v).shape == w_ref.shape
+    )
+    lr._check_trajectory(w_out, w_ref, w_true)
     return {
         "metric": f"grpc_logreg_b{batch_size}_i{n_iter}",
         "value": round(statistics.median(times), 4),

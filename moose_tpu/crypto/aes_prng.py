@@ -131,10 +131,15 @@ def derive_seed(key_bytes: bytes, session_id: str,
                 sync_key: bytes) -> bytes:
     """The reference's DeriveSeed kernel (host/prim.rs:123-147):
     blake3-derive a hashing key from the PRF key, then keyed-hash
-    ``session_id_bytes(16) || sync_key(16)`` and take 16 output bytes."""
-    from .blake3 import derive_key, keyed_hash
+    ``sid_bytes(16) || sync_key(16)`` and take 16 output bytes.
+
+    ``sid_bytes`` is SessionId::as_bytes(): the blake3-256 hash of the
+    logical session-id string truncated to 16 bytes
+    (computation.rs:108-128) — NOT the raw string.  The sync key IS the
+    raw bytes zero-padded to 16 (computation.rs SyncKey TryFrom)."""
+    from .blake3 import blake3, derive_key, keyed_hash
 
     derived = derive_key("Derive Seed", bytes(key_bytes))
-    sid = session_id.encode()[:16].ljust(16, b"\x00")
+    sid = blake3(session_id.encode(), out_len=16)
     sk = bytes(sync_key)[:16].ljust(16, b"\x00")
     return keyed_hash(derived, sid + sk, out_len=16)

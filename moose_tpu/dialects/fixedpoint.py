@@ -348,7 +348,16 @@ def norm(sess, rep, x: RepTensor, max_bits: int, positive: bool = False):
     """(|x| upshifted to put its top bit at max_bits-1, signed scale factor)
     (division.rs:107-139).  ``positive=True`` skips the msb/sign round
     entirely — a caller that KNOWS x > 0 (softmax's sum of positive
-    exponentials, sigmoid's 1 + e^x) saves a full secure comparison."""
+    exponentials, sigmoid's 1 + e^x) saves a full secure comparison.
+
+    Deviation from the reference (documented, deliberate): division.rs
+    returns ``upshifted = x * top`` — the SIGNED value — which makes the
+    Goldschmidt seed ``2.9142 - 2*upshifted`` ~2x too large in magnitude
+    for negative x (|1 - x*w| ~ 0.96, far outside the seed bound the
+    theta iteration count assumes; the reference's own tests never
+    exercise a negative divisor, division.rs:258-323).  We return the
+    ABSOLUTE upshifted value — abs_x is already computed, so the cost is
+    identical — and carry the sign exclusively in signed_top."""
     if positive:
         top = top_most_index(sess, rep, x, max_bits)
         upshifted = rep_ops.mul(sess, rep, x, top)
@@ -358,7 +367,7 @@ def norm(sess, rep, x: RepTensor, max_bits: int, positive: bool = False):
     sign = sign_from_msb(sess, rep, m_ring)
     abs_x = rep_ops.mul(sess, rep, sign, x)
     top = top_most_index(sess, rep, abs_x, max_bits)
-    upshifted = rep_ops.mul(sess, rep, x, top)
+    upshifted = rep_ops.mul(sess, rep, abs_x, top)
     signed_top = rep_ops.mul(sess, rep, sign, top)
     return upshifted, signed_top
 

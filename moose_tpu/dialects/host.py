@@ -111,9 +111,38 @@ def ring_constant(ints, width: int, plc: str) -> HostRingTensor:
 # ---------------------------------------------------------------------------
 
 
+# Deterministic sync-key streams: the jit self-check gate
+# (execution/interpreter._SelfCheckRunner) must run the eager reference
+# and the jit candidate over IDENTICAL nonce sequences so their results
+# compare bit-for-bit (nonces are public; seed security rests on the
+# master key, which stays fresh per evaluation).
+import contextlib as _contextlib
+import contextvars as _contextvars
+
+_SYNC_KEY_STREAM: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "moose_tpu_sync_key_stream", default=None
+)
+
+
+@_contextlib.contextmanager
+def deterministic_sync_keys(seed: int):
+    """Within the context, :func:`random_sync_key` draws from a Philox
+    stream seeded by ``seed`` instead of OS entropy, so two executions
+    of the same op walk see the same nonce sequence."""
+    rng = np.random.Generator(np.random.Philox(int(seed)))
+    token = _SYNC_KEY_STREAM.set(rng)
+    try:
+        yield
+    finally:
+        _SYNC_KEY_STREAM.reset(token)
+
+
 def random_sync_key() -> bytes:
     """Trace-time random nonce identifying one seed derivation
     (reference SyncKey::random())."""
+    stream = _SYNC_KEY_STREAM.get()
+    if stream is not None:
+        return stream.bytes(16)
     return secrets.token_bytes(16)
 
 

@@ -592,6 +592,13 @@ _F64_CHUNK = 1 << 20
 # cliff on big contractions where Eigen/BLAS would vectorize)
 _F64_MIN_WORK = 1 << 21
 
+# Exactness ceiling of the f64 path's u64 diagonal accumulation: each
+# 16-bit-limb product is < 2^32 and a diagonal sums up to 8 limb pairs
+# over k terms in uint64, so 8 * k * 2^32 must stay < 2^64 -> k <= 2^28.
+# Beyond it the lost carries would silently corrupt the high limb; the
+# strategy selectors below fall back to the generic limb path instead.
+_F64_MAX_K = 1 << 28
+
 
 def _limbs16_f64(x, n_limbs: int):
     """Split a uint64 array into 16-bit limbs cast to float64 (integers
@@ -659,7 +666,7 @@ def _limb_matmul_pairs_f64(a, b, in_limbs: int, out_limbs: int):
 
 def _f64_worth_it(a, b) -> bool:
     work = a.shape[0] * a.shape[-1] * b.shape[-1]
-    return work >= _F64_MIN_WORK
+    return work >= _F64_MIN_WORK and a.shape[-1] <= _F64_MAX_K
 
 
 def _matmul_u64_limb_f64(a, b):

@@ -381,6 +381,7 @@ class WorkerServer:
             # mid-fanout) must let the hard timeout fire even while the
             # other peers keep answering
             all_live = True
+            all_completed = bool(state.peers)
             for peer in state.peers:
                 if state.cancel.is_set():
                     return
@@ -403,8 +404,11 @@ class WorkerServer:
                         return
                     if peer_session not in ("running", "completed"):
                         all_live = False
+                    if peer_session != "completed":
+                        all_completed = False
                 except Exception as e:  # noqa: BLE001 — rpc failure
                     all_live = False
+                    all_completed = False
                     if (
                         not seen[peer]
                         and time.monotonic() - start < self.startup_grace
@@ -426,7 +430,13 @@ class WorkerServer:
                         ]
                         self._fanout_abort(session_id, reason, survivors)
                         return
-            if all_live and state.peers:
+            # a round where EVERY peer reports 'completed' cannot deliver
+            # anything new to this worker's pending receives — bumping
+            # progress would extend their deadlines forever when a value
+            # this worker still awaits was never sent (role/graph
+            # mismatch, dropped send); let the no-progress timeout fire
+            # instead (ADVICE r3)
+            if all_live and state.peers and not all_completed:
                 state.progress.bump()
 
     def _send_value(self, request: bytes, context=None) -> bytes:

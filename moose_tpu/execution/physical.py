@@ -317,7 +317,8 @@ def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
             env[n] = execute_kernel(sess, op, plc, args)
 
 
-def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
+def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
+                segment_limit=None, jit_segments: bool = True):
     """Build (and jit) the execution closure for one (computation,
     binding) pair; cached by PhysicalInterpreter across calls."""
     import jax
@@ -354,9 +355,11 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
 
     from .interpreter import _segment_limit
 
-    if use_jit and len(order) > _segment_limit():
+    limit = segment_limit if segment_limit is not None else _segment_limit()
+    if use_jit and len(order) > limit:
         fn = _build_segmented_physical(
-            comp_ref, order, static_env, dyn_names, key_ops, recv_src
+            comp_ref, order, static_env, dyn_names, key_ops, recv_src,
+            limit, jit_segments,
         )
         return order, key_ops, dyn_names, static_env, fn
 
@@ -374,16 +377,21 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool):
         )
         return outputs, saves
 
-    fn = jax.jit(core) if use_jit else core
+    fn = jax.jit(core) if (use_jit and jit_segments) else core
     return order, key_ops, dyn_names, static_env, fn
 
 
 def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
-                              key_ops, recv_src):
+                              key_ops, recv_src, limit=None,
+                              jit_segments: bool = True):
     """Segment a lowered graph into separately-jitted XLA programs (see
     interpreter._build_segmented_plan for the rationale).  Receive ops
     read their Send's input through ``recv_src``, so cross-segment
-    transfers are ordinary boundary values."""
+    transfers are ordinary boundary values.  ``jit_segments=False``
+    keeps the structure but dispatches each segment eagerly — the exact
+    reference the jit self-check compares against (the lowered graph is
+    fully deterministic given the ``keys`` input: sync keys are baked
+    attributes, so no nonce pinning is needed here)."""
     import jax
 
     from .interpreter import _segment_limit, plan_segments
@@ -397,7 +405,8 @@ def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
         return op.inputs
 
     chunks, in_names, out_names = plan_segments(
-        order, static_env, effective_inputs, _segment_limit()
+        order, static_env, effective_inputs,
+        limit if limit is not None else _segment_limit(),
     )
     dyn_set = set(dyn_names)
     key_set = set(key_ops)
@@ -422,7 +431,7 @@ def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
             )
             return {n: env[n] for n in outs}, outputs, saves
 
-        return jax.jit(seg)
+        return jax.jit(seg) if jit_segments else seg
 
     seg_fns = [make_seg(si, names) for si, names in enumerate(chunks)]
 
@@ -444,6 +453,58 @@ def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
     return run
 
 
+class _PhysicalSelfCheckRunner:
+    """Self-check over LOWERED computations: the physical plan takes all
+    PRF keys as runtime inputs and every sync key is a baked graph
+    attribute, so eager and jitted execution of the same plan from the
+    same ``keys`` dict must be bit-identical with no nonce pinning.
+    State machine shared with the logical runner (interpreter
+    _SelfCheckBase)."""
+
+    def __init__(self, comp, arguments, checks: int):
+        import weakref
+
+        from .interpreter import _SelfCheckBase
+
+        self._comp_ref = weakref.ref(comp)
+        self._arguments = arguments
+        self.eager_plan = _build_plan(comp, arguments, False)
+
+        outer = self
+
+        class _Impl(_SelfCheckBase):
+            def _build_candidate(self):
+                comp = outer._comp_ref()
+                if comp is None:  # pragma: no cover - defensive
+                    raise KernelError("computation was garbage-collected")
+                limit = self.LADDER[self._level]
+                jit_plan = _build_plan(
+                    comp, outer._arguments, True, segment_limit=limit
+                )
+                ref_plan = _build_plan(
+                    comp, outer._arguments, True, segment_limit=limit,
+                    jit_segments=False,
+                )
+                self._jit_fn = jit_plan[4]
+                self._ref_fn = ref_plan[4]
+
+            def _eager_fn(self, *args):
+                return outer.eager_plan[4](*args)
+
+            def _on_promoted(self):
+                super()._on_promoted()
+                outer._arguments = None
+
+        self._impl = _Impl(checks)
+
+    @property
+    def mode(self):
+        return self._impl.mode
+
+    def run(self, keys, dyn):
+        return self._impl.run(keys, dyn)
+
+
 class PhysicalInterpreter:
     """Executes lowered computations with plan/jit caching (same weak-key
     discipline as the logical Interpreter)."""
@@ -460,19 +521,28 @@ class PhysicalInterpreter:
         arguments: Optional[dict] = None,
         use_jit: bool = True,
     ) -> dict:
-        from .interpreter import heavy_jit_gate
+        from .interpreter import _selfcheck_runs, heavy_jit_gate
 
         arguments = arguments or {}
-        use_jit = heavy_jit_gate(len(comp.operations), use_jit)
+        gated = heavy_jit_gate(len(comp.operations), use_jit)
+        selfcheck = use_jit and not gated and _selfcheck_runs() > 0
+        use_jit = gated
         per_comp = self._cache.get(comp)
         if per_comp is None:
             per_comp = self._cache[comp] = {}
         from .interpreter import binding_cache_key
 
-        cache_key = binding_cache_key(arguments, use_jit)
+        cache_key = binding_cache_key(arguments, (use_jit, selfcheck))
         plan = per_comp.get(cache_key)
         if plan is None:
-            plan = _build_plan(comp, arguments, use_jit)
+            if selfcheck:
+                runner = _PhysicalSelfCheckRunner(
+                    comp, arguments, _selfcheck_runs()
+                )
+                order, key_ops, dyn_names, static_env, _ = runner.eager_plan
+                plan = (order, key_ops, dyn_names, static_env, runner.run)
+            else:
+                plan = _build_plan(comp, arguments, use_jit)
             per_comp[cache_key] = plan
         order, key_ops, dyn_names, static_env, fn = plan
 

