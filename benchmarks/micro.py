@@ -1,0 +1,242 @@
+"""Executor / networking / runtime micro-benchmarks.
+
+The counterpart of the reference's criterion benches
+(``moose/benches/exec.rs`` — deep op chains through the executors,
+``moose/benches/networking.rs`` — transport round-trips,
+``moose/benches/runtime.rs`` — whole-session overhead): fast regression
+tripwires for the scheduler, dispatch, serde, and transport layers, as
+opposed to the macro benchmarks (dot_product.py / logreg.py) that track
+protocol throughput.
+
+  python benchmarks/micro.py            # all suites, one JSON line each
+  python benchmarks/micro.py --suite exec --depth 200
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import moose_tpu as pm
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def _emit(record):
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def _median_time(fn, reps):
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+# ---------------------------------------------------------------------------
+# exec: deep sequential op chain through both executors (benches/exec.rs)
+# ---------------------------------------------------------------------------
+
+
+def _chain_comp(depth):
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))
+    ):
+        with alice:
+            y = x
+            for _ in range(depth):
+                y = pm.add(y, x)
+        return y
+
+    return comp
+
+
+def bench_exec(depth=200, reps=5):
+    """Per-op dispatch cost of the eager interpreter vs the jitted plan
+    on a depth-N Add chain (the executor's scheduling overhead, isolated
+    from math: the adds are scalar-ish)."""
+    comp = _chain_comp(depth)
+    x = np.ones((16,))
+    out = []
+    for use_jit, name in ((False, "eager"), (True, "jit")):
+        runtime = LocalMooseRuntime(["alice"], use_jit=use_jit)
+        run = lambda: runtime.evaluate_computation(comp, arguments={"x": x})
+        first_s = _median_time(run, 1)  # includes trace+compile (cached after)
+        t = _median_time(run, reps)
+        out.append(
+            _emit(
+                {
+                    "metric": f"exec_chain_{name}_ops_per_sec",
+                    "value": round(depth / t, 1),
+                    "unit": "ops/s",
+                    "depth": depth,
+                    "steady_latency_s": round(t, 6),
+                    "first_call_s": round(first_s, 6),
+                }
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runtime: whole-session overhead for a trivial graph (benches/runtime.rs)
+# ---------------------------------------------------------------------------
+
+
+def bench_runtime(reps=20):
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def tiny(
+        x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))
+    ):
+        with alice:
+            y = pm.add(x, x)
+        return y
+
+    runtime = LocalMooseRuntime(["alice"], use_jit=False)
+    x = np.ones((4,))
+    runtime.evaluate_computation(tiny, arguments={"x": x})  # warm caches
+    t = _median_time(
+        lambda: runtime.evaluate_computation(tiny, arguments={"x": x}), reps
+    )
+    return _emit(
+        {
+            "metric": "runtime_session_evaluations_per_sec",
+            "value": round(1.0 / t, 1),
+            "unit": "sessions/s",
+            "steady_latency_s": round(t, 6),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# serde + networking transports (benches/networking.rs)
+# ---------------------------------------------------------------------------
+
+
+def bench_serde(nbytes=8 << 20, reps=10):
+    from moose_tpu.serde import deserialize_value, serialize_value
+
+    value = np.random.default_rng(0).random(nbytes // 8)
+    blob = serialize_value(value)
+    t_ser = _median_time(lambda: serialize_value(value), reps)
+    t_de = _median_time(lambda: deserialize_value(blob, "alice"), reps)
+    return _emit(
+        {
+            "metric": "serde_roundtrip_gbytes_per_sec",
+            "value": round(nbytes / (t_ser + t_de) / 1e9, 3),
+            "unit": "GB/s",
+            "serialize_gbps": round(nbytes / t_ser / 1e9, 3),
+            "deserialize_gbps": round(nbytes / t_de / 1e9, 3),
+            "payload_mb": nbytes >> 20,
+        }
+    )
+
+
+def bench_networking_inmem(reps=200):
+    from moose_tpu.distributed.networking import LocalNetworking
+
+    net = LocalNetworking()
+    small = np.ones((8,))
+    big = np.random.default_rng(1).random(1 << 20)  # 8 MB
+
+    def roundtrip(value, key):
+        net.send(value, "bob", key, "bench-sess")
+        return net.receive("alice", key, "bench-sess", "bob", timeout=5.0)
+
+    t_small = _median_time(lambda: roundtrip(small, "k-small"), reps)
+    t_big = _median_time(lambda: roundtrip(big, "k-big"), max(3, reps // 20))
+    return _emit(
+        {
+            "metric": "networking_inmem_roundtrips_per_sec",
+            "value": round(1.0 / t_small, 1),
+            "unit": "roundtrips/s",
+            "big_payload_gbps": round(big.nbytes / t_big / 1e9, 3),
+        }
+    )
+
+
+def bench_networking_tcp(reps=100):
+    """Loopback round-trips through the native C++ TCP transport
+    (native/tcp_transport.cpp; reference networking/tcpstream.rs)."""
+    from moose_tpu.distributed.networking import TcpNetworking
+
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    endpoints = {
+        "alice": f"127.0.0.1:{free_port()}",
+        "bob": f"127.0.0.1:{free_port()}",
+    }
+    a = TcpNetworking("alice", endpoints).start()
+    b = TcpNetworking("bob", endpoints).start()
+    try:
+        small = np.ones((8,))
+        big = np.random.default_rng(2).random(1 << 20)  # 8 MB
+        seq = [0]
+
+        def roundtrip(value):
+            seq[0] += 1
+            key = f"k{seq[0]}"
+            a.send(value, "bob", key, "bench-sess")
+            return b.receive("alice", key, "bench-sess", "bob", timeout=10.0)
+
+        roundtrip(small)  # connection warmup
+        t_small = _median_time(lambda: roundtrip(small), reps)
+        t_big = _median_time(lambda: roundtrip(big), max(3, reps // 20))
+        return _emit(
+            {
+                "metric": "networking_tcp_roundtrips_per_sec",
+                "value": round(1.0 / t_small, 1),
+                "unit": "roundtrips/s",
+                "big_payload_gbps": round(big.nbytes / t_big / 1e9, 3),
+            }
+        )
+    finally:
+        a.stop()
+        b.stop()
+
+
+SUITES = {
+    "exec": bench_exec,
+    "runtime": bench_runtime,
+    "serde": bench_serde,
+    "net-inmem": bench_networking_inmem,
+    "net-tcp": bench_networking_tcp,
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--suite", choices=sorted(SUITES), default=None)
+    parser.add_argument("--depth", type=int, default=200)
+    args = parser.parse_args(argv)
+    if args.suite == "exec":
+        bench_exec(depth=args.depth)
+    elif args.suite:
+        SUITES[args.suite]()
+    else:
+        bench_exec(depth=args.depth)
+        bench_runtime()
+        bench_serde()
+        bench_networking_inmem()
+        bench_networking_tcp()
+
+
+if __name__ == "__main__":
+    main()

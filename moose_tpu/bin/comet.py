@@ -55,6 +55,14 @@ def main(argv=None):
         help="only this certificate CN may launch/abort sessions "
         "(requires the --tls-* flags)",
     )
+    parser.add_argument(
+        "--telemetry", nargs="?", const="http://localhost:4318",
+        default=os.environ.get("MOOSE_TPU_OTLP"), metavar="OTLP_ENDPOINT",
+        help="export spans to an OTLP/HTTP collector (Jaeger, Tempo, "
+        "otel-collector); bare --telemetry targets the local default "
+        "collector port, like the reference's comet --telemetry "
+        "(comet.rs:30-41)",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -62,6 +70,12 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if args.telemetry:
+        from moose_tpu import telemetry
+
+        telemetry.configure_otlp(
+            args.telemetry, service_name=f"comet:{args.identity}"
+        )
     from moose_tpu.distributed.choreography import WorkerServer
 
     storage = None

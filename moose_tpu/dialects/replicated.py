@@ -291,9 +291,13 @@ def _mul_like(sess, rep, x: RepTensor, y: RepTensor, contract):
         plc = p[i]
         x_i, x_i1 = x.shares[i]
         y_i, y_i1 = y.shares[i]
+        # regrouped cross product: x_i·y_i + x_i·y_{i+1} + x_{i+1}·y_i
+        # = x_i·(y_i + y_{i+1}) + x_{i+1}·y_i — bit-exact (contraction
+        # distributes over ring addition), one fewer contraction than the
+        # reference's 3-term form (replicated/arith.rs:317-367)
         v = sess.add(
             plc,
-            sess.add(plc, contract(plc, x_i, y_i), contract(plc, x_i, y_i1)),
+            contract(plc, x_i, sess.add(plc, y_i, y_i1)),
             contract(plc, x_i1, y_i),
         )
         vs.append(v)
@@ -350,13 +354,12 @@ def and_bits(sess, rep, x: RepTensor, y: RepTensor) -> RepTensor:
         plc = p[i]
         x_i, x_i1 = x.shares[i]
         y_i, y_i1 = y.shares[i]
+        # regrouped: (x_i & y_i) ^ (x_i & y_{i+1}) ^ (x_{i+1} & y_i)
+        # = (x_i & (y_i ^ y_{i+1})) ^ (x_{i+1} & y_i) — AND distributes
+        # over XOR, so one fewer AND than the 3-term form
         v = sess.xor(
             plc,
-            sess.xor(
-                plc,
-                sess.and_(plc, x_i, y_i),
-                sess.and_(plc, x_i, y_i1),
-            ),
+            sess.and_(plc, x_i, sess.xor(plc, y_i, y_i1)),
             sess.and_(plc, x_i1, y_i),
         )
         vs.append(v)

@@ -515,22 +515,63 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
     lists, as u64 arrays.
 
     Unsigned 8-bit limbs don't fit int8, so limbs are centered
-    (limb - 128) and each product de-centered with rank-1 corrections:
+    (limb - 128) and products de-centered with rank-1 corrections:
       A_i . B_j = A'_i . B'_j + 128*(rowsum(A'_i) + colsum(B'_j)) + 128^2*k
-    Centered products accumulate exactly in s32 for k <= 2^17, so unlike
-    the f32 path no chunking is needed.  On v5e int8 matmul runs at 2x
-    bf16 throughput.  For small contractions (k <= 2047) the de-centered
-    values and whole diagonal sums still fit int32, so the 64-bit work
-    (emulated 32-bit pairs on TPU) shrinks to one widening per diagonal;
-    larger k accumulates per-pair in s64.
+    Centered products accumulate exactly in s32 for k <= 2^17.  On v5e
+    int8 matmul runs at 2x bf16 throughput.
+
+    For small contractions (k <= 2047, the common case) each diagonal is
+    ONE dot_general: diagonal s of the limb polynomial product is a
+    contiguous slice of concat(A_0..A_15) contracted against a contiguous
+    slice of concat(B_15..B_0) — pair (i, s-i) sits at A-offset i*k and
+    B_rev-offset (L-1-s+i)*k, both advancing together as i grows.  The
+    cross-pair accumulation therefore happens inside the MXU contraction
+    loop (no per-pair s32 intermediates materialized to HBM), and the
+    de-centering correction collapses to one rank-1 add per diagonal.
+    Whole diagonals stay exact in s32 because
+    pairs_per_diag * k * 255^2 < 2^31; larger k accumulates per-pair in
+    s64 on the fallback path.
     """
     in_limbs = len(la)
     # de-centering correction vectors, exact in s32 (k*128 < 2^31)
     ra = [jnp.sum(x.astype(jnp.int32), axis=-1) for x in la]  # (m,)
     cb = [jnp.sum(x.astype(jnp.int32), axis=0) for x in lb]  # (n,)
-    i32_diag = k <= _INT8_I32_DIAG_MAX_K
-    acc_ty = jnp.int32 if i32_diag else jnp.int64
-    bias = acc_ty(128 * 128 * k)
+    if k > _INT8_I32_DIAG_MAX_K:
+        return _int8_pair_diags_s64(la, lb, ra, cb, out_limbs, k)
+    if _os.environ.get("MOOSE_TPU_INT8_DIAG") == "pairs":
+        # A/B escape hatch: the pre-slab per-pair formulation
+        return _int8_pair_diags_pairs_i32(la, lb, ra, cb, out_limbs, k)
+    afull = jnp.concatenate(la, axis=-1)  # (m, in_limbs*k)
+    brev = jnp.concatenate(lb[::-1], axis=0)  # (in_limbs*k, n)
+    diags = []
+    for s in range(out_limbs):
+        i0 = max(0, s - (in_limbs - 1))
+        i1 = min(s, in_limbs - 1)
+        npairs = i1 - i0 + 1
+        a_sl = afull[:, i0 * k:(i1 + 1) * k]
+        b0 = (in_limbs - 1 - s + i0) * k
+        b_sl = brev[b0:b0 + npairs * k, :]
+        ps = jax.lax.dot_general(
+            a_sl, b_sl, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        tra = sum(ra[i] for i in range(i0, i1 + 1))  # (m,) s32
+        tcb = sum(cb[s - i] for i in range(i0, i1 + 1))  # (n,) s32
+        ps = ps + (
+            jnp.int32(128) * (tra[:, None] + tcb[None, :])
+            + jnp.int32(128 * 128 * k * npairs)
+        )
+        # single widening per diagonal; values are exact non-negative
+        # int32, so the s64 intermediate is sign-safe
+        diags.append(ps.astype(jnp.int64).astype(U64))
+    return diags
+
+
+def _int8_pair_diags_pairs_i32(la, lb, ra, cb, out_limbs: int, k: int):
+    """Per-pair dot_generals with s32 diagonal accumulation (the pre-slab
+    formulation, kept behind MOOSE_TPU_INT8_DIAG=pairs for comparison)."""
+    in_limbs = len(la)
+    bias = jnp.int32(128 * 128 * k)
     m, n = la[0].shape[0], lb[0].shape[-1]
     diags = []
     for s in range(out_limbs):
@@ -542,24 +583,43 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
             p = jax.lax.dot_general(
                 la[i], lb[j], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
-            ).astype(acc_ty)
-            p = p + (
-                acc_ty(128) * (ra[i][:, None] + cb[j][None, :]).astype(
-                    acc_ty
-                )
-                + bias
             )
-            if not i32_diag:
-                p = p.astype(U64)
+            p = p + (
+                jnp.int32(128) * (ra[i][:, None] + cb[j][None, :]) + bias
+            )
             ps = p if ps is None else ps + p
         if ps is None:
             diags.append(jnp.zeros((m, n), dtype=U64))
-        elif i32_diag:
-            # single widening per diagonal; values are exact non-negative
-            # int32, so the s64 intermediate is sign-safe
-            diags.append(ps.astype(jnp.int64).astype(U64))
         else:
-            diags.append(ps)
+            diags.append(ps.astype(jnp.int64).astype(U64))
+    return diags
+
+
+def _int8_pair_diags_s64(la, lb, ra, cb, out_limbs: int, k: int):
+    """Per-pair fallback for k > 2047: de-centered values exceed int32,
+    so each pair product widens to s64 before accumulation."""
+    in_limbs = len(la)
+    bias = jnp.int64(128 * 128 * k)
+    m, n = la[0].shape[0], lb[0].shape[-1]
+    diags = []
+    for s in range(out_limbs):
+        ps = None
+        for i in range(min(s + 1, in_limbs)):
+            j = s - i
+            if j >= in_limbs:
+                continue
+            p = jax.lax.dot_general(
+                la[i], lb[j], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.int64)
+            p = p + (
+                jnp.int64(128)
+                * (ra[i][:, None] + cb[j][None, :]).astype(jnp.int64)
+                + bias
+            )
+            p = p.astype(U64)
+            ps = p if ps is None else ps + p
+        diags.append(ps if ps is not None else jnp.zeros((m, n), dtype=U64))
     return diags
 
 

@@ -253,10 +253,14 @@ def _results_equal(a, b) -> bool:
     lb, tb = jax.tree_util.tree_flatten(b)
     if ta != tb or len(la) != len(lb):
         return False
-    return all(
-        np.array_equal(np.asarray(x), np.asarray(y))
-        for x, y in zip(la, lb)
-    )
+    def eq(x, y):
+        x, y = np.asarray(x), np.asarray(y)
+        # identical NaNs on both paths are agreement, not divergence
+        # (np.array_equal rejects equal_nan for non-float dtypes)
+        equal_nan = x.dtype.kind == "f" and y.dtype.kind == "f"
+        return np.array_equal(x, y, equal_nan=equal_nan)
+
+    return all(eq(x, y) for x, y in zip(la, lb))
 
 
 class _SelfCheckBase:

@@ -200,7 +200,14 @@ def zero_share(sess: SpmdSession, shape, width: int):
 
 
 def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
-    """v_i = f(x_i, y_i) + f(x_i, y_{i+1}) + f(x_{i+1}, y_i), per party."""
+    """v_i = x_i·(y_i + y_{i+1}) + x_{i+1}·y_i, per party.
+
+    Regrouped form of the standard 3-term cross product
+    x_i·y_i + x_i·y_{i+1} + x_{i+1}·y_i (replicated/arith.rs:317-367):
+    the contraction distributes over ring addition mod 2^w, so the
+    regrouping is bit-exact while doing TWO contractions instead of
+    three — a 33% cut in MXU work for the dominant phase of secure
+    mul/dot (the y-pair add is a cheap elementwise ring add)."""
 
     def take(t, slot):
         return (
@@ -210,9 +217,8 @@ def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
 
     x0, y0 = take(x, 0), take(y, 0)
     x1, y1 = take(x, 1), take(y, 1)
-    v_lo, v_hi = contract(*x0, *y0)
-    t_lo, t_hi = contract(*x0, *y1)
-    v_lo, v_hi = ring.add(v_lo, v_hi, t_lo, t_hi)
+    ys_lo, ys_hi = ring.add(*y0, *y1)
+    v_lo, v_hi = contract(*x0, ys_lo, ys_hi)
     t_lo, t_hi = contract(*x1, *y0)
     return ring.add(v_lo, v_hi, t_lo, t_hi)
 
@@ -277,30 +283,34 @@ def public_sub(c_lo, c_hi, x: SpmdRep) -> SpmdRep:
     return add_public(neg(x), c_lo, c_hi)
 
 
-def fill_public(shape, width: int, raw: int) -> SpmdRep:
-    """Trivial replicated sharing of a public ring constant: x_0 = v,
-    x_1 = x_2 = 0, so only pair slots (party 0, slot 0) and (party 2,
-    slot 1) hold v."""
-    v_lo, v_hi = ring.fill_like_shape(shape, width, raw)
-    z_lo = jnp.zeros_like(v_lo)
-    lo = jnp.stack(
+def public_to_rep(lo, hi, width: int) -> SpmdRep:
+    """Trivial replicated sharing of a public plaintext ring tensor:
+    x_0 = v, x_1 = x_2 = 0, so only pair slots (party 0, slot 0) and
+    (party 2, slot 1) hold v."""
+    z_lo = jnp.zeros_like(lo)
+    out_lo = jnp.stack(
         [
-            jnp.stack([v_lo, z_lo]),
+            jnp.stack([lo, z_lo]),
             jnp.stack([z_lo, z_lo]),
-            jnp.stack([z_lo, v_lo]),
+            jnp.stack([z_lo, lo]),
         ]
     )
-    hi = None
-    if v_hi is not None:
-        z_hi = jnp.zeros_like(v_hi)
-        hi = jnp.stack(
+    out_hi = None
+    if hi is not None:
+        z_hi = jnp.zeros_like(hi)
+        out_hi = jnp.stack(
             [
-                jnp.stack([v_hi, z_hi]),
+                jnp.stack([hi, z_hi]),
                 jnp.stack([z_hi, z_hi]),
-                jnp.stack([z_hi, v_hi]),
+                jnp.stack([z_hi, hi]),
             ]
         )
-    return SpmdRep(lo, hi, width)
+    return SpmdRep(out_lo, out_hi, width)
+
+
+def fill_public(shape, width: int, raw: int) -> SpmdRep:
+    """Trivial replicated sharing of a public ring constant."""
+    return public_to_rep(*ring.fill_like_shape(shape, width, raw), width)
 
 
 # Structural ops: pure share-local data movement on the logical axes

@@ -100,7 +100,8 @@ def bits_and(sess: SpmdSession, x: SpmdBits, y: SpmdBits) -> SpmdBits:
     + reshare roll (stacked ``replicated.and_bits``)."""
     x0, x1 = x.arr[:, 0], x.arr[:, 1]
     y0, y1 = y.arr[:, 0], y.arr[:, 1]
-    v = (x0 & y0) ^ (x0 & y1) ^ (x1 & y0)
+    # regrouped cross terms (AND distributes over XOR): one fewer AND
+    v = (x0 & (y0 ^ y1)) ^ (x1 & y0)
     s = sess.sample_bit_bank(v.shape[1:])
     alpha = s ^ jnp.roll(s, -1, axis=0)
     z = v ^ alpha
@@ -297,28 +298,8 @@ def mul_public_raw(x: SpmdRep, raw: int) -> SpmdRep:
     return spmd.mul_public(x, c_lo, c_hi)
 
 
-def public_to_rep(lo, hi, width: int) -> SpmdRep:
-    """Trivial replicated sharing of a public plaintext ring tensor:
-    x_0 = v, x_1 = x_2 = 0 (pair slots (0,0) and (2,1) hold v)."""
-    z_lo = jnp.zeros_like(lo)
-    out_lo = jnp.stack(
-        [
-            jnp.stack([lo, z_lo]),
-            jnp.stack([z_lo, z_lo]),
-            jnp.stack([z_lo, lo]),
-        ]
-    )
-    out_hi = None
-    if hi is not None:
-        z_hi = jnp.zeros_like(hi)
-        out_hi = jnp.stack(
-            [
-                jnp.stack([hi, z_hi]),
-                jnp.stack([z_hi, z_hi]),
-                jnp.stack([z_hi, hi]),
-            ]
-        )
-    return SpmdRep(out_lo, out_hi, width)
+# trivial public sharing lives with the layout in spmd.py
+public_to_rep = spmd.public_to_rep
 
 
 def sign_from_msb(msb_ring: SpmdRep) -> SpmdRep:

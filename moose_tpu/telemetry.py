@@ -18,7 +18,14 @@ entry point:
   zero-egress environments get a file instead of a collector);
 - ``MOOSE_TPU_TRACE=1`` additionally prints every completed root tree to
   stderr, the moral equivalent of ``RUST_LOG=debug`` on the reference
-  binaries.
+  binaries;
+- ``configure_otlp(endpoint)`` (or ``MOOSE_TPU_OTLP=http://host:4318``,
+  or ``comet --telemetry``) exports every completed root tree to an
+  OTLP/HTTP collector (Jaeger, Grafana Tempo, otel-collector, ...) —
+  the counterpart of the reference's ``telemetry`` feature that ships
+  worker spans to Jaeger (``reindeer.rs:7-30``, ``comet.rs:30-41``).
+  The exporter is stdlib-only (urllib on a daemon thread), never blocks
+  the caller, and drops batches rather than stall a worker.
 
 Runtimes surface coarse phase timings as ``runtime.last_timings``
 (micros, like the reference's per-role map).
@@ -28,12 +35,17 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import sys
 import threading
 import time
+import urllib.request
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+# maps perf_counter timestamps (span clock) onto the unix epoch for OTLP
+_EPOCH_OFFSET_S = time.time() - time.perf_counter()
 
 
 @dataclass
@@ -107,6 +119,9 @@ def span(name: str, **attrs):
             _state.last_root = s
             if _echo_enabled():
                 report(file=sys.stderr)
+            exporter = _get_exporter()
+            if exporter is not None:
+                exporter.export(s)
 
 
 def last_trace() -> Optional[Span]:
@@ -141,6 +156,181 @@ def report(file=None) -> None:
         print("(no trace recorded)", file=out)
     else:
         emit(root, 0)
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP span export (reference: tracing-opentelemetry + Jaeger agent
+# behind the `telemetry` feature, reindeer.rs:7-30; enabled per worker by
+# `comet --telemetry`, comet.rs:30-41).  Stdlib-only: spans are encoded
+# with the OTLP JSON mapping and POSTed to {endpoint}/v1/traces from a
+# daemon thread so a slow or absent collector can never stall a worker.
+# ---------------------------------------------------------------------------
+
+
+def _otlp_attr_value(v: Any) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # OTLP JSON carries int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(attrs: Dict[str, Any]) -> list:
+    return [
+        {"key": str(k), "value": _otlp_attr_value(v)}
+        for k, v in attrs.items()
+    ]
+
+
+class OtlpExporter:
+    """Exports completed root span trees to an OTLP/HTTP collector."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "moose_tpu",
+        timeout_s: float = 2.0,
+        max_queue: int = 256,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.endswith("/v1/traces"):
+            self.endpoint += "/v1/traces"
+        self.service_name = service_name
+        self.timeout_s = timeout_s
+        self.dropped = 0
+        self.exported = 0
+        self.last_error: Optional[str] = None
+        self._q: "queue.Queue[Optional[Span]]" = queue.Queue(
+            maxsize=max_queue
+        )
+        self._thread = threading.Thread(
+            target=self._drain, daemon=True, name="otlp-export"
+        )
+        self._thread.start()
+
+    # -- producer side (span completion; must never block) --
+    def export(self, root: Span) -> None:
+        try:
+            self._q.put_nowait(root)
+        except queue.Full:
+            self.dropped += 1
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Wait until everything queued so far has been sent (tests)."""
+        # an event sentinel rides the queue behind everything already
+        # enqueued; when the worker reaches it, all prior batches have
+        # finished their POSTs
+        done = threading.Event()
+        self._q.put(done)
+        return done.wait(timeout_s)
+
+    # -- consumer side --
+    def _drain(self) -> None:
+        while True:
+            root = self._q.get()
+            if isinstance(root, threading.Event):
+                root.set()
+                continue
+            try:
+                self._post(self.encode(root))
+                self.exported += 1
+            except Exception as e:  # collector down: drop, remember why
+                self.dropped += 1
+                self.last_error = str(e)
+
+    def _post(self, payload: dict) -> None:
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+    def encode(self, root: Span) -> dict:
+        """One root tree -> one OTLP resourceSpans payload."""
+        trace_id = os.urandom(16).hex()
+        spans: List[dict] = []
+
+        def walk(s: Span, parent_id: Optional[str]) -> None:
+            span_id = os.urandom(8).hex()
+            start_ns = int((s.start_s + _EPOCH_OFFSET_S) * 1e9)
+            end_ns = int((s.end_s + _EPOCH_OFFSET_S) * 1e9)
+            rec = {
+                "traceId": trace_id,
+                "spanId": span_id,
+                "name": s.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(start_ns),
+                "endTimeUnixNano": str(end_ns),
+                "attributes": _otlp_attrs(s.attrs),
+            }
+            if parent_id is not None:
+                rec["parentSpanId"] = parent_id
+            spans.append(rec)
+            for child in s.children:
+                walk(child, span_id)
+
+        walk(root, None)
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": _otlp_attrs(
+                            {"service.name": self.service_name}
+                        )
+                    },
+                    "scopeSpans": [
+                        {"scope": {"name": "moose_tpu"}, "spans": spans}
+                    ],
+                }
+            ]
+        }
+
+
+_exporter: Optional[OtlpExporter] = None
+_exporter_env_checked = False
+_exporter_lock = threading.Lock()
+
+
+def configure_otlp(
+    endpoint: str, service_name: str = "moose_tpu"
+) -> OtlpExporter:
+    """Install the global OTLP exporter; completed root span trees are
+    shipped to ``endpoint`` from now on.  Returns the exporter (tests use
+    ``.flush()``/``.exported``)."""
+    global _exporter, _exporter_env_checked
+    with _exporter_lock:
+        _exporter = OtlpExporter(endpoint, service_name=service_name)
+        _exporter_env_checked = True
+        return _exporter
+
+
+def disable_otlp() -> None:
+    global _exporter, _exporter_env_checked
+    with _exporter_lock:
+        _exporter = None
+        _exporter_env_checked = True
+
+
+def _get_exporter() -> Optional[OtlpExporter]:
+    """Active exporter, lazily honouring MOOSE_TPU_OTLP on first use."""
+    global _exporter, _exporter_env_checked
+    if _exporter is not None or _exporter_env_checked:
+        return _exporter
+    with _exporter_lock:
+        if not _exporter_env_checked:
+            _exporter_env_checked = True
+            endpoint = os.environ.get("MOOSE_TPU_OTLP")
+            if endpoint:
+                _exporter = OtlpExporter(
+                    endpoint,
+                    service_name=os.environ.get(
+                        "MOOSE_TPU_OTLP_SERVICE", "moose_tpu"
+                    ),
+                )
+    return _exporter
 
 
 def phase_timings(root: Optional[Span] = None) -> Dict[str, int]:

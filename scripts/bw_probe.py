@@ -1,0 +1,68 @@
+"""Measure achievable HBM bandwidth + PRF sampling rate on this chip
+(scan-chained, scalar readback)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import moose_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+
+def chain(body, init, T=50):
+    @jax.jit
+    def run():
+        c, _ = jax.lax.scan(body, init, None, length=T)
+        return jnp.sum(c)
+
+    float(run())
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = run()
+        float(s)
+        times.append(time.perf_counter() - t0)
+    return min(times) / T
+
+
+n = 3000  # 72 MB u64
+x = jax.device_put(np.random.default_rng(0).integers(0, 1 << 63, (n, n), dtype=np.uint64))
+
+# elementwise add: reads 2*72, writes 72 => 216 MB per iter
+t = chain(lambda c, _: (c + x, None), x)
+print(f"u64 add: {t*1e3:.3f} ms  {216e6/t/1e9:.0f} GB/s")
+
+# u64 mul (emulated 32-bit on TPU)
+t = chain(lambda c, _: (c * x, None), x)
+print(f"u64 mul: {t*1e3:.3f} ms  {216e6/t/1e9:.0f} GB/s")
+
+# f32 add, same footprint in elements (36 MB arrays => 108 MB)
+xf = jax.device_put(np.random.default_rng(0).random((n, n), np.float32))
+t = chain(lambda c, _: (c + xf, None), xf)
+print(f"f32 add: {t*1e3:.3f} ms  {108e6/t/1e9:.0f} GB/s")
+
+# rbg draw of (2,3,n,n) u64 = 144 MB + xor fold into carry (reads+writes ~288MB)
+from moose_tpu.dialects import ring
+
+
+def body(c, _):
+    seed = ring.mix_seed(
+        jnp.asarray([1, 2, 3, 4], jnp.uint32),
+        jnp.stack([c[0, 0, 0].astype(jnp.uint32), jnp.uint32(1), jnp.uint32(2), jnp.uint32(3)]),
+    )
+    lo, hi = ring.sample_uniform_seeded((3, n, n), seed, 128)
+    return c ^ lo ^ hi, None
+
+
+t = chain(body, x[None].repeat(3, 0).reshape(3, n, n), T=20)
+mb = 3 * n * n * 8 * 2
+print(f"rbg 128-bit bank draw ({mb/1e6:.0f} MB): {t*1e3:.3f} ms  {mb/t/1e9:.1f} GB/s")
+
+os.environ_bak = None
+ring.set_prf_impl("threefry")
+t = chain(body, x[None].repeat(3, 0).reshape(3, n, n), T=20)
+print(f"threefry 128-bit bank draw ({mb/1e6:.0f} MB): {t*1e3:.3f} ms  {mb/t/1e9:.1f} GB/s")

@@ -1,0 +1,52 @@
+"""Measure achievable dense matmul throughput on this chip (int8/bf16),
+to calibrate MFU claims. Forces execution via scalar readback."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import moose_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+
+def bench(m, k, n, dtype, acc, iters=30):
+    rng = np.random.default_rng(0)
+    if dtype == jnp.int8:
+        a = jax.device_put(rng.integers(-128, 127, (m, k), np.int8))
+        b = jax.device_put(rng.integers(-128, 127, (k, n), np.int8))
+    else:
+        a = jax.device_put(rng.normal(size=(m, k)).astype(dtype))
+        b = jax.device_put(rng.normal(size=(k, n)).astype(dtype))
+
+    @jax.jit
+    def f(a, b):
+        p = jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())), preferred_element_type=acc
+        )
+        return jnp.sum(p.astype(jnp.float32) if acc != jnp.float32 else p)
+
+    float(f(a, b))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s = f(a, b)
+        float(s)
+        times.append((time.perf_counter() - t0) / iters)
+    t = min(times)
+    tops = 2 * m * k * n / t / 1e12
+    print(f"{m}x{k}x{n} {np.dtype(dtype).name}->{np.dtype(acc).name}: "
+          f"{t*1e3:.3f} ms  {tops:.1f} TOP/s")
+
+
+for sz in (1000, 1024, 4096):
+    bench(sz, sz, sz, jnp.int8, jnp.int32)
+    bench(sz, sz, sz, jnp.bfloat16, jnp.float32)
+bench(8192, 8192, 8192, jnp.int8, jnp.int32, iters=10)
+bench(8192, 8192, 8192, jnp.bfloat16, jnp.float32, iters=10)
+bench(1000, 16000, 1000, jnp.int8, jnp.int32)
+bench(3000, 16000, 3000, jnp.int8, jnp.int32, iters=10)

@@ -752,9 +752,13 @@ def test_sigkilled_comet_worker_fails_session_everywhere(tmp_path):
         # is load-tolerant (this 1-core rig runs benches concurrently);
         # unloaded the detection takes ~2-4 s.
         assert elapsed < 60.0, f"failure took {elapsed:.1f}s to surface"
+        # any of the three valid propagation paths may win the race:
+        # direct unreachability detection, abort fanout from the peer
+        # that detected it, or abort status learned via liveness ping
         assert (
             "unreachable" in result["error"]
             or "aborted by" in result["error"]
+            or "aborted on peer" in result["error"]
         ), result
     finally:
         for p in procs.values():

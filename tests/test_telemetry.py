@@ -126,3 +126,163 @@ def test_eager_per_op_spans_compiled_path(monkeypatch):
         compiler_passes=["typing", "lowering", "prune", "toposort"],
     )
     assert "op:Add" in runtime.last_timings, runtime.last_timings
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP export (reference: comet --telemetry ships spans to Jaeger,
+# comet.rs:30-41 + reindeer.rs:7-30)
+# ---------------------------------------------------------------------------
+
+
+class _Collector:
+    """Minimal in-process OTLP/HTTP collector capturing POSTed payloads."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        collector = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers["Content-Length"])
+                collector.requests.append(
+                    (self.path, json.loads(self.rfile.read(length)))
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        self.requests = []
+        self.server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.endpoint = f"http://127.0.0.1:{self.server.server_port}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_otlp_export_ships_root_trees():
+    collector = _Collector()
+    try:
+        exporter = telemetry.configure_otlp(
+            collector.endpoint, service_name="test-svc"
+        )
+        with telemetry.span("root", session_id="s1"):
+            with telemetry.span("child", n_ops=7):
+                pass
+            with telemetry.span("child2"):
+                pass
+        assert exporter.flush(timeout_s=10.0)
+        assert exporter.exported == 1 and exporter.dropped == 0
+    finally:
+        telemetry.disable_otlp()
+        collector.close()
+
+    (path, payload), = collector.requests
+    assert path == "/v1/traces"
+    resource = payload["resourceSpans"][0]
+    svc = {
+        a["key"]: a["value"] for a in resource["resource"]["attributes"]
+    }
+    assert svc["service.name"] == {"stringValue": "test-svc"}
+    spans = resource["scopeSpans"][0]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"root", "child", "child2"}
+    root = by_name["root"]
+    assert "parentSpanId" not in root
+    # children share the root's trace and point at its spanId
+    for name in ("child", "child2"):
+        assert by_name[name]["traceId"] == root["traceId"]
+        assert by_name[name]["parentSpanId"] == root["spanId"]
+    # OTLP JSON nano timestamps are strings and ordered
+    assert int(root["startTimeUnixNano"]) <= int(
+        by_name["child"]["startTimeUnixNano"]
+    )
+    assert int(root["endTimeUnixNano"]) >= int(
+        by_name["child2"]["endTimeUnixNano"]
+    )
+    # attribute typing: ints ride intValue (as strings, per the mapping)
+    child_attrs = {
+        a["key"]: a["value"] for a in by_name["child"]["attributes"]
+    }
+    assert child_attrs["n_ops"] == {"intValue": "7"}
+
+
+def test_otlp_export_runtime_spans_end_to_end():
+    """A real evaluate_computation exports its span tree."""
+    collector = _Collector()
+    try:
+        exporter = telemetry.configure_otlp(collector.endpoint)
+        alice = pm.host_placement("alice")
+
+        @pm.computation
+        def comp(
+            x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))
+        ):
+            with alice:
+                y = pm.add(x, x)
+            return y
+
+        runtime = LocalMooseRuntime(["alice"], use_jit=False)
+        runtime.evaluate_computation(comp, arguments={"x": np.ones((2,))})
+        assert exporter.flush(timeout_s=10.0)
+        assert exporter.exported >= 1
+    finally:
+        telemetry.disable_otlp()
+        collector.close()
+
+    names = set()
+    for _, payload in collector.requests:
+        for rs in payload["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                names.update(s["name"] for s in ss["spans"])
+    assert "evaluate_computation" in names
+    # the runtime's phase children ride along in the same tree
+    assert {"trace", "execute"} <= names
+
+
+def test_otlp_collector_down_never_raises():
+    """An unreachable collector drops batches without breaking spans."""
+    try:
+        exporter = telemetry.configure_otlp("http://127.0.0.1:9")  # discard
+        with telemetry.span("root"):
+            pass
+        exporter.flush(timeout_s=10.0)
+        assert exporter.dropped >= 1
+        assert exporter.last_error
+        assert telemetry.last_trace().name == "root"
+    finally:
+        telemetry.disable_otlp()
+
+
+def test_comet_telemetry_flag_wires_exporter(monkeypatch):
+    """comet --telemetry ENDPOINT installs the OTLP exporter before the
+    worker starts (reference comet.rs:30-41)."""
+    from moose_tpu.bin import comet
+
+    installed = {}
+
+    def fake_configure(endpoint, service_name="moose_tpu"):
+        installed["endpoint"] = endpoint
+        installed["service"] = service_name
+        raise SystemExit(0)  # stop before the server binds
+
+    monkeypatch.setattr(telemetry, "configure_otlp", fake_configure)
+    try:
+        comet.main([
+            "--identity", "alice", "--port", "50901",
+            "--endpoints", "alice=localhost:50901",
+            "--telemetry", "http://collector:4318",
+        ])
+    except SystemExit:
+        pass
+    assert installed == {
+        "endpoint": "http://collector:4318",
+        "service": "comet:alice",
+    }
