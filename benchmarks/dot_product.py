@@ -12,6 +12,11 @@ import json
 import statistics
 import time
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import moose_tpu as pm
@@ -218,9 +223,19 @@ def main():
         "graphs; slow to XLA-compile for big chains); spmd = party-stacked "
         "kernels, shares device-resident across the chain (default)",
     )
+    parser.add_argument(
+        "--prf", choices=["rbg", "threefry", "threefry-pallas", "aes-ctr"], default=None,
+        help="PRF for mask generation (default: the library default; "
+        "threefry is the cryptographic mode distributed workers require)",
+    )
     parser.add_argument("--all", action="store_true",
                         help="run every reference table row")
     args = parser.parse_args()
+    if args.prf:
+        from moose_tpu.dialects import ring as _ring
+
+        _ring.set_prf_impl(args.prf)
+
 
     rows = (
         [(c, n, s, ref) for c, n, s, ref in REFERENCE_ROWS]
@@ -235,6 +250,9 @@ def main():
         if ref is not None:
             result["reference_s"] = ref
             result["speedup"] = ref / result["median_s"]
+        from moose_tpu.dialects import ring as _ring
+
+        result["prf"] = _ring.get_prf_impl()
         print(json.dumps(result), flush=True)
 
 

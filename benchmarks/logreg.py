@@ -12,9 +12,15 @@ import json
 import statistics
 import time
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import moose_tpu as pm
+from moose_tpu.dialects import ring as _ring
 from moose_tpu.runtime import LocalMooseRuntime
 
 alice = pm.host_placement("alice")
@@ -213,6 +219,7 @@ def run_spmd(batch_size, n_batches, n_exp):
         "max_s": max(times),
         "weight_corr": float(corr),
         "trajectory_max_abs_err": traj_err,
+        "prf": _ring.get_prf_impl(),
     }))
 
 
@@ -227,7 +234,17 @@ def main():
         "graph); spmd = party-stacked kernels with the batch loop under "
         "lax.scan (plain SGD; default)",
     )
+    parser.add_argument(
+        "--prf", choices=["rbg", "threefry", "threefry-pallas", "aes-ctr"], default=None,
+        help="PRF for mask generation (default: the library default; "
+        "threefry is the cryptographic mode distributed workers require)",
+    )
     args = parser.parse_args()
+    if args.prf:
+        from moose_tpu.dialects import ring as _ring
+
+        _ring.set_prf_impl(args.prf)
+
     if args.engine == "spmd":
         run_spmd(args.batch_size, args.n_iter, args.n_exp)
         return
@@ -269,6 +286,7 @@ def main():
         "max_s": max(times),
         "weight_corr": float(corr),
         "trajectory_max_abs_err": traj_err,
+        "prf": _ring.get_prf_impl(),
     }))
 
 

@@ -1,0 +1,217 @@
+"""Secure softmax/argmax on TPU: stacked-SPMD fused vs per-op eager.
+
+VERDICT r3 weak-point 1 / task 3: heavy protocol graphs (secure softmax
+lowers to ~10k host ops) used to be gated to per-op eager dispatch on
+TPU because of the known axon-backend fusion miscompile.  Two escapes
+now exist and this bench measures both against the eager floor:
+
+  spmd    the party-stacked nonlinear library (parallel/spmd_math.py):
+          softmax/argmax as ONE small fused XLA program per step —
+          the layout that sidesteps the miscompile by construction
+          (regular kernels instead of a 10k-op lowered graph).
+  jit     the logical-graph path under the validated-jit self-check
+          (interpreter.py: segmented candidate promoted only after
+          bit-exact agreement with a structure-identical eager run).
+  eager   the library-default safe path on TPU (per-op dispatch).
+
+Run: python benchmarks/softmax_bench.py [--rows 64] [--classes 10]
+Prints one JSON line per mode; correctness is asserted against jax.nn
+softmax/argmax on the plaintext within fixed-point tolerance.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import moose_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+I, F, W = 14, 23, 128
+
+
+def bench_spmd(rows, classes, t_iters=5, reps=3):
+    from moose_tpu.parallel import spmd
+    from moose_tpu.parallel import spmd_math as sm
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(rows, classes)) * 2.0
+    mk = np.frombuffer(b"moose-tpu-bench!", dtype=np.uint32)
+
+    @jax.jit
+    def one(master_key, x_f):
+        sess = spmd.SpmdSession(master_key)
+        xs = spmd.fx_encode_share(sess, x_f, I, F, W)
+        probs = sm.fx_softmax(sess, xs, 1)
+        am = sm.fx_argmax(sess, xs, 1)
+        return (
+            spmd.fx_reveal_decode(probs),
+            spmd.reveal(am)[0],
+        )
+
+    da = jax.device_put(x)
+    probs, am = one(mk, da)
+    probs, am = np.asarray(probs), np.asarray(am)
+    want = np.asarray(jax.nn.softmax(x, axis=1))
+    err = np.abs(probs - want).max()
+    assert err < 2e-2, f"softmax mismatch: {err}"
+    am_want = x.argmax(axis=1)
+    agree = (am == am_want).mean()
+    assert agree > 0.99, f"argmax agreement: {agree}"
+
+    @jax.jit
+    def chained(master_key, x_f):
+        keys = spmd.derive_step_keys(
+            jnp.asarray(master_key, jnp.uint32), t_iters
+        )
+
+        def body(c, k):
+            sess = spmd.SpmdSession(k)
+            xs = spmd.fx_encode_share(sess, x_f + c, I, F, W)
+            probs = sm.fx_softmax(sess, xs, 1)
+            return jnp.sum(spmd.fx_reveal_decode(probs)) * 1e-9, None
+
+        c, _ = jax.lax.scan(body, jnp.float64(0), keys)
+        return c
+
+    float(chained(mk, da))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s = chained(mk, da)
+        float(s)
+        times.append(time.perf_counter() - t0)
+    per_iter = min(times) / t_iters
+    return {
+        "metric": "secure_softmax_spmd_latency",
+        "value": round(per_iter, 4),
+        "unit": "s",
+        "rows": rows,
+        "classes": classes,
+        "softmax_max_err": float(err),
+        "argmax_agreement": float(agree),
+    }
+
+
+def _runtime_softmax(rows, classes, use_jit, heavy_jit, reps=3):
+    import moose_tpu as pm
+    from moose_tpu.runtime import LocalMooseRuntime
+
+    if heavy_jit:
+        os.environ["MOOSE_TPU_TPU_JIT_HEAVY"] = "1"
+    else:
+        os.environ.pop("MOOSE_TPU_TPU_JIT_HEAVY", None)
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement(name="rep", players=[alice, bob, carole])
+    fixed = pm.fixed(I, F)
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            xf = pm.cast(x, dtype=fixed)
+        with rep:
+            probs = pm.softmax(xf, axis=1, upmost_index=classes)
+        with carole:
+            out = pm.cast(probs, dtype=pm.float64)
+        return out
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(rows, classes)) * 2.0
+    runtime = LocalMooseRuntime(
+        ["alice", "bob", "carole"], use_jit=use_jit
+    )
+    t0 = time.perf_counter()
+    (out,) = runtime.evaluate_computation(comp, arguments={"x": x}).values()
+    first_s = time.perf_counter() - t0
+    want = np.asarray(jax.nn.softmax(x, axis=1))
+    err = np.abs(np.asarray(out) - want).max()
+    assert err < 2e-2, f"softmax mismatch: {err}"
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        runtime.evaluate_computation(comp, arguments={"x": x})
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), first_s, float(err)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--rows", type=int, default=64)
+    parser.add_argument("--classes", type=int, default=10)
+    parser.add_argument(
+        "--modes", default="spmd,jit,eager",
+        help="comma-set of spmd,jit,eager",
+    )
+    args = parser.parse_args()
+    modes = set(args.modes.split(","))
+
+    results = {}
+    if "spmd" in modes:
+        rec = bench_spmd(args.rows, args.classes)
+        results["spmd"] = rec["value"]
+        print(json.dumps(rec), flush=True)
+    if "jit" in modes:
+        lat, first, err = _runtime_softmax(
+            args.rows, args.classes, use_jit=True, heavy_jit=True
+        )
+        results["jit"] = lat
+        print(
+            json.dumps(
+                {
+                    "metric": "secure_softmax_validated_jit_latency",
+                    "value": round(lat, 4),
+                    "unit": "s",
+                    "rows": args.rows,
+                    "classes": args.classes,
+                    "first_call_s": round(first, 2),
+                    "max_err": err,
+                }
+            ),
+            flush=True,
+        )
+    if "eager" in modes:
+        lat, first, err = _runtime_softmax(
+            args.rows, args.classes, use_jit=False, heavy_jit=False
+        )
+        results["eager"] = lat
+        print(
+            json.dumps(
+                {
+                    "metric": "secure_softmax_eager_latency",
+                    "value": round(lat, 4),
+                    "unit": "s",
+                    "rows": args.rows,
+                    "classes": args.classes,
+                    "first_call_s": round(first, 2),
+                    "max_err": err,
+                }
+            ),
+            flush=True,
+        )
+    if "eager" in results:
+        speedups = {
+            f"{m}_speedup_vs_eager": round(results["eager"] / results[m], 1)
+            for m in ("spmd", "jit")
+            if m in results
+        }
+        print(json.dumps({"metric": "secure_softmax_speedups", **speedups}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,7 +74,10 @@ def main(argv=None):
         from moose_tpu import telemetry
 
         telemetry.configure_otlp(
-            args.telemetry, service_name=f"comet:{args.identity}"
+            args.telemetry,
+            service_name=os.environ.get(
+                "MOOSE_TPU_OTLP_SERVICE", f"comet:{args.identity}"
+            ),
         )
     from moose_tpu.distributed.choreography import WorkerServer
 

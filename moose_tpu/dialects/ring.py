@@ -270,7 +270,7 @@ def equal_bits(lo1, hi1, lo2, hi2):
 # "threefry" (a real reduced-Threefish PRF) for anything deployed across
 # trust domains.  Distributed runtimes call ``require_strong_prf()`` and
 # refuse to run on rbg unless MOOSE_TPU_ALLOW_WEAK_PRF=1 is set explicitly.
-_PRF_IMPLS = ("rbg", "threefry", "aes-ctr")
+_PRF_IMPLS = ("rbg", "threefry", "aes-ctr", "threefry-pallas")
 _PRF_IMPL = _os.environ.get("MOOSE_TPU_PRF", "rbg")
 if _PRF_IMPL not in _PRF_IMPLS:
     raise ValueError(
@@ -280,9 +280,13 @@ if _PRF_IMPL not in _PRF_IMPLS:
 
 def set_prf_impl(name: str) -> None:
     """Select the PRF: "rbg" (fast Philox; local simulation), "threefry"
-    (cryptographic, jittable), or "aes-ctr" (the REFERENCE's construction
-    — blake3 seed derivation + AES-128-CTR expansion on the host, for
-    bit-compatibility checks against pymoose; eager-only)."""
+    (cryptographic, jittable), "threefry-pallas" (same cipher family,
+    expanded by the custom Pallas TPU kernel in ``pallas_prf.py`` —
+    cryptographic and jittable; currently slower than the stock
+    threefry lowering on v5e, see benchmarks/README.md), or "aes-ctr"
+    (the REFERENCE's construction — blake3 seed derivation +
+    AES-128-CTR expansion on the host, for bit-compatibility checks
+    against pymoose; eager-only)."""
     global _PRF_IMPL
     if name not in _PRF_IMPLS:
         from ..errors import ConfigurationError
@@ -362,6 +366,13 @@ def _concrete_seed_bytes(seed_u32x4) -> bytes:
 
 def sample_uniform_seeded(shape, seed_u32x4, width: int):
     shape = tuple(int(s) for s in shape)
+    if _PRF_IMPL == "threefry-pallas":
+        from . import pallas_prf
+
+        if width == 64:
+            return pallas_prf.random_bits_u64(seed_u32x4, shape), None
+        both = pallas_prf.random_bits_u64(seed_u32x4, (2,) + shape)
+        return both[1], both[0]
     if _PRF_IMPL == "aes-ctr":
         from ..crypto.aes_prng import AesCtrRng
 
@@ -385,6 +396,18 @@ def sample_uniform_seeded(shape, seed_u32x4, width: int):
 
 def sample_bits_seeded(shape, seed_u32x4, width: int):
     shape = tuple(int(s) for s in shape)
+    if _PRF_IMPL == "threefry-pallas":
+        from . import pallas_prf
+
+        # one u64 word yields 64 output bits — draw ceil(n/64) words and
+        # unpack, rather than burning a full cipher word per bit
+        n = int(np.prod(shape)) if shape else 1
+        words = pallas_prf.random_bits_u64(seed_u32x4, (-(-n // 64),))
+        shifts = jnp.arange(64, dtype=U64)
+        bits = ((words[:, None] >> shifts) & jnp.uint64(1)).reshape(-1)
+        lo = bits[:n].reshape(shape)
+        hi = jnp.zeros_like(lo) if width == 128 else None
+        return lo, hi
     if _PRF_IMPL == "aes-ctr":
         from ..crypto.aes_prng import AesCtrRng
 
@@ -520,39 +543,54 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
     Centered products accumulate exactly in s32 for k <= 2^17.  On v5e
     int8 matmul runs at 2x bf16 throughput.
 
-    For small contractions (k <= 2047, the common case) each diagonal is
-    ONE dot_general: diagonal s of the limb polynomial product is a
-    contiguous slice of concat(A_0..A_15) contracted against a contiguous
-    slice of concat(B_15..B_0) — pair (i, s-i) sits at A-offset i*k and
-    B_rev-offset (L-1-s+i)*k, both advancing together as i grows.  The
-    cross-pair accumulation therefore happens inside the MXU contraction
-    loop (no per-pair s32 intermediates materialized to HBM), and the
-    de-centering correction collapses to one rank-1 add per diagonal.
-    Whole diagonals stay exact in s32 because
-    pairs_per_diag * k * 255^2 < 2^31; larger k accumulates per-pair in
-    s64 on the fallback path.
+    Two formulations for small contractions (k <= 2047, the common
+    case), both exact and both SPMD-sharding-safe (k stays an ordinary
+    per-array contraction dim, so a sharded k partitions as local
+    partial dots + all-reduce):
+
+    - per-pair (default): one dot_general per (i, j) pair, s32 diagonal
+      accumulation, one widening per diagonal — measured fastest on the
+      chained secure dot on v5e;
+    - slab (``MOOSE_TPU_INT8_DIAG=slab``): limbs stacked on a fresh
+      leading axis (A ascending, B reversed) so diagonal s's pair set
+      is a contiguous range of BOTH stacks, and the stack axis joins k
+      as a second contracting dimension — ONE dot_general per diagonal
+      with cross-pair accumulation inside the MXU loop and a single
+      rank-1 de-centering correction.
+
+    k > 2047 accumulates per-pair in s64 on the fallback path.
     """
     in_limbs = len(la)
-    # de-centering correction vectors, exact in s32 (k*128 < 2^31)
-    ra = [jnp.sum(x.astype(jnp.int32), axis=-1) for x in la]  # (m,)
-    cb = [jnp.sum(x.astype(jnp.int32), axis=0) for x in lb]  # (n,)
+    # de-centering correction vectors, exact in s32 (k*128 < 2^31).
+    # dtype pinned: under x64 mode jnp.sum would silently promote to
+    # int64, dragging every correction into emulated 64-bit arithmetic
+    # on TPU (measured 1.8x on the chained secure dot)
+    ra = [
+        jnp.sum(x.astype(jnp.int32), axis=-1, dtype=jnp.int32) for x in la
+    ]  # (m,)
+    cb = [
+        jnp.sum(x.astype(jnp.int32), axis=0, dtype=jnp.int32) for x in lb
+    ]  # (n,)
     if k > _INT8_I32_DIAG_MAX_K:
         return _int8_pair_diags_s64(la, lb, ra, cb, out_limbs, k)
-    if _os.environ.get("MOOSE_TPU_INT8_DIAG") == "pairs":
-        # A/B escape hatch: the pre-slab per-pair formulation
+    if _os.environ.get("MOOSE_TPU_INT8_DIAG", "pairs") != "slab":
+        # default: per-pair dot_generals with s32 diagonal accumulation —
+        # measured fastest on the chained secure dot (10.0 ms/dot vs
+        # 13.0 for the slab form on v5e; benchmarks/README.md); the slab
+        # variant below stays selectable for A/B on other topologies
         return _int8_pair_diags_pairs_i32(la, lb, ra, cb, out_limbs, k)
-    afull = jnp.concatenate(la, axis=-1)  # (m, in_limbs*k)
-    brev = jnp.concatenate(lb[::-1], axis=0)  # (in_limbs*k, n)
+    astack = jnp.stack(la)  # (L, m, k)
+    brev = jnp.stack(lb[::-1])  # (L, k, n)
     diags = []
     for s in range(out_limbs):
         i0 = max(0, s - (in_limbs - 1))
         i1 = min(s, in_limbs - 1)
         npairs = i1 - i0 + 1
-        a_sl = afull[:, i0 * k:(i1 + 1) * k]
-        b0 = (in_limbs - 1 - s + i0) * k
-        b_sl = brev[b0:b0 + npairs * k, :]
+        a_sl = astack[i0:i1 + 1]  # (npairs, m, k)
+        b0 = in_limbs - 1 - s + i0
+        b_sl = brev[b0:b0 + npairs]  # (npairs, k, n)
         ps = jax.lax.dot_general(
-            a_sl, b_sl, (((1,), (0,)), ((), ())),
+            a_sl, b_sl, (((0, 2), (0, 1)), ((), ())),
             preferred_element_type=jnp.int32,
         )
         tra = sum(ra[i] for i in range(i0, i1 + 1))  # (m,) s32
@@ -568,8 +606,9 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
 
 
 def _int8_pair_diags_pairs_i32(la, lb, ra, cb, out_limbs: int, k: int):
-    """Per-pair dot_generals with s32 diagonal accumulation (the pre-slab
-    formulation, kept behind MOOSE_TPU_INT8_DIAG=pairs for comparison)."""
+    """Per-pair dot_generals with s32 diagonal accumulation — the DEFAULT
+    formulation (fastest measured on v5e; MOOSE_TPU_INT8_DIAG=slab
+    selects the slab variant in :func:`_int8_pair_diags`)."""
     in_limbs = len(la)
     bias = jnp.int32(128 * 128 * k)
     m, n = la[0].shape[0], lb[0].shape[-1]

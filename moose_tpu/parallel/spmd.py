@@ -234,23 +234,20 @@ def mul(sess: SpmdSession, x: SpmdRep, y: SpmdRep) -> SpmdRep:
     return _reshare(sess, v_lo, v_hi, x.width)
 
 
+def _dot_contract(a_lo, a_hi, b_lo, b_hi):
+    """Party-batched ring matmul: the limb-decomposed MXU path in
+    ``ring.matmul`` vmaps cleanly over the party axis, so the parties'
+    local contractions run as one batched MXU program."""
+    if a_hi is None:
+        f = jax.vmap(lambda p, q: ring.matmul(p, None, q, None)[0])
+        return f(a_lo, b_lo), None
+    f = jax.vmap(lambda p, ph, q, qh: ring.matmul(p, ph, q, qh))
+    return f(a_lo, a_hi, b_lo, b_hi)
+
+
 def dot(sess: SpmdSession, x: SpmdRep, y: SpmdRep) -> SpmdRep:
-    """Party-batched secure matmul: three vmapped ring matmuls + reshare.
-
-    The limb-decomposed MXU path in ``ring.matmul`` vmaps cleanly over the
-    party axis, so the 3 parties' local contractions run as one batched
-    MXU program."""
-
-    def contract(a_lo, a_hi, b_lo, b_hi):
-        if a_hi is None:
-            f = jax.vmap(lambda p, q: ring.matmul(p, None, q, None)[0])
-            return f(a_lo, b_lo), None
-        f = jax.vmap(
-            lambda p, ph, q, qh: ring.matmul(p, ph, q, qh)
-        )
-        return f(a_lo, a_hi, b_lo, b_hi)
-
-    v_lo, v_hi = _cross_terms(x, y, contract)
+    """Secure matmul: two regrouped party-batched contractions + reshare."""
+    v_lo, v_hi = _cross_terms(x, y, _dot_contract)
     return _reshare(sess, v_lo, v_hi, x.width)
 
 
@@ -369,18 +366,24 @@ def sum_axis(x: SpmdRep, axis: int) -> SpmdRep:
 
 
 def trunc_pr(sess: SpmdSession, x: SpmdRep, amount: int) -> SpmdRep:
-    width = x.width
-    k = width - 1
-    shape = x.shape
-
     def h(t, i, j):
         return None if t is None else t[i, j]
 
     # rep -> 2-party additive: a0 = x0 + x1 (party 0 holds both), a1 = x2.
-    a0_lo, a0_hi = ring.add(
-        x.lo[0, 0], h(x.hi, 0, 0), x.lo[0, 1], h(x.hi, 0, 1)
-    )
-    a1_lo, a1_hi = x.lo[1, 1], h(x.hi, 1, 1)
+    a0 = ring.add(x.lo[0, 0], h(x.hi, 0, 0), x.lo[0, 1], h(x.hi, 0, 1))
+    a1 = (x.lo[1, 1], h(x.hi, 1, 1))
+    return _trunc_pr_adt(sess, a0, a1, x.width, amount, x.shape, x.hi is not None)
+
+
+def _trunc_pr_adt(sess, a0, a1, width, amount, shape, has_hi) -> SpmdRep:
+    """Probabilistic truncation from a 2-party additive sharing
+    (a0 + a1 = x): the shared core of :func:`trunc_pr` and the fused
+    multiply-then-truncate paths, which feed the additive sharing
+    straight from the cross products + zero-share without materializing
+    the intermediate replicated pair layout."""
+    k = width - 1
+    a0_lo, a0_hi = a0
+    a1_lo, a1_hi = a1
 
     # provider (party 2) samples the masks and additively shares them
     r_lo, r_hi = sess.sample(shape, width)
@@ -411,9 +414,19 @@ def trunc_pr(sess: SpmdSession, x: SpmdRep, amount: int) -> SpmdRep:
     ctop_lo, ctop_hi = ring.shr(cns_lo, cns_hi, amount + 1)
     cmsb_lo, cmsb_hi = ring.shr(c_lo, c_hi, width - 1)
 
-    # overflow = r_msb XOR c_msb, additively: rm + cmsb - 2*rm*cmsb
+    # overflow = r_msb XOR c_msb, additively: rm + cmsb - 2*rm*cmsb.
+    # c is the REVEALED masked value, so cmsb is a public 0/1: the
+    # rm*cmsb ring multiplication is a select (cheaper than the
+    # multi-pass emulated u128 multiply on TPU)
+    cmsb_on = cmsb_lo.astype(bool)
+
     def adt_overflow(rm, first: bool):
-        p_lo, p_hi = ring.mul(rm[0], rm[1], cmsb_lo, cmsb_hi)
+        p_lo = jnp.where(cmsb_on, rm[0], jnp.zeros_like(rm[0]))
+        p_hi = (
+            jnp.where(cmsb_on, rm[1], jnp.zeros_like(rm[1]))
+            if rm[1] is not None
+            else None
+        )
         tw_lo, tw_hi = ring.shl(p_lo, p_hi, 1)
         o_lo, o_hi = ring.sub(rm[0], rm[1], tw_lo, tw_hi)
         if first:
@@ -435,9 +448,33 @@ def trunc_pr(sess: SpmdSession, x: SpmdRep, amount: int) -> SpmdRep:
     z1_lo, z1_hi = ring.sub(y0_lo, y0_hi, z0_lo, z0_hi)
     z_lo = jnp.stack([z0_lo, z1_lo, y1_lo], axis=0)
     z_hi = (
-        jnp.stack([z0_hi, z1_hi, y1_hi], axis=0) if x.hi is not None else None
+        jnp.stack([z0_hi, z1_hi, y1_hi], axis=0) if has_hi else None
     )
     return _pairs(z_lo, z_hi, width)
+
+
+def _mul_like_trunc(sess, x, y, contract, amount: int) -> SpmdRep:
+    """Fused multiply-and-truncate: cross products + zero-share, then
+    feed the (3,)-stacked z directly into truncation's 2-party additive
+    form (a0 = z_0 + z_1, a1 = z_2) instead of materializing the
+    replicated pair layout that trunc_pr would immediately collapse.
+    Bit-identical to _reshare followed by trunc_pr (same PRF draw
+    order, pure data-movement skipped); saves two full passes over the
+    (3, 2, *shape) pair arrays — significant because this chip's
+    elementwise phases are HBM-bound (benchmarks/roofline.py)."""
+    width = x.width
+    v_lo, v_hi = _cross_terms(x, y, contract)
+    a_lo, a_hi = zero_share(sess, v_lo.shape[1:], width)
+    z_lo, z_hi = ring.add(v_lo, v_hi, a_lo, a_hi)
+
+    def h(t, i):
+        return None if t is None else t[i]
+
+    a0 = ring.add(z_lo[0], h(z_hi, 0), z_lo[1], h(z_hi, 1))
+    a1 = (z_lo[2], h(z_hi, 2))
+    return _trunc_pr_adt(
+        sess, a0, a1, width, amount, z_lo.shape[1:], z_hi is not None
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -472,8 +509,9 @@ def fx_sub(x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
 
 
 def fx_mul(sess, x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
-    z = mul(sess, x.tensor, y.tensor)
-    z = trunc_pr(sess, z, x.fractional_precision)
+    z = _mul_like_trunc(
+        sess, x.tensor, y.tensor, ring.mul, x.fractional_precision
+    )
     return SpmdFixed(
         z,
         max(x.integral_precision, y.integral_precision),
@@ -482,8 +520,9 @@ def fx_mul(sess, x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
 
 
 def fx_dot(sess, x: SpmdFixed, y: SpmdFixed) -> SpmdFixed:
-    z = dot(sess, x.tensor, y.tensor)
-    z = trunc_pr(sess, z, x.fractional_precision)
+    z = _mul_like_trunc(
+        sess, x.tensor, y.tensor, _dot_contract, x.fractional_precision
+    )
     return SpmdFixed(
         z,
         max(x.integral_precision, y.integral_precision),

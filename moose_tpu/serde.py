@@ -209,6 +209,7 @@ def _encode_ndarray_raw(arr: np.ndarray) -> dict:
     (e.g. ``<f8``), so the bytes decode identically on any host."""
     if arr.dtype == object:
         return _encode_ndarray(arr)  # bigint ring constants: slow path
+    shape = list(arr.shape)  # before ascontiguousarray: it promotes 0-d to 1-d
     arr = np.ascontiguousarray(arr)
     if arr.dtype.byteorder == ">":  # pragma: no cover - exotic hosts
         arr = arr.astype(arr.dtype.newbyteorder("<"))
@@ -216,7 +217,7 @@ def _encode_ndarray_raw(arr: np.ndarray) -> dict:
         "__type__": "ndarray_raw",
         "dtype": arr.dtype.str,
         "data": arr.tobytes(),
-        "shape": list(arr.shape),
+        "shape": shape,
     }
 
 
@@ -652,7 +653,12 @@ def deserialize_value(data: bytes, plc: str = ""):
     if tag == "HostUnit":
         return HostUnit(plc)
     if tag == "RawNdarray":
-        return obj["value"]
+        value = obj["value"]
+        if isinstance(value, np.ndarray) and not value.flags.writeable:
+            # frombuffer views are read-only; user-facing raw arrays keep
+            # the old writable contract
+            value = value.copy()
+        return value
     if tag == "PyScalar":
         return obj["value"]
     raise MalformedComputationError(f"cannot deserialize value tag {tag!r}")

@@ -226,10 +226,17 @@ class OtlpExporter:
         self._q.put(done)
         return done.wait(timeout_s)
 
+    def shutdown(self) -> None:
+        """Stop the drain thread (after finishing everything queued)."""
+        self._q.put(_SHUTDOWN)
+        self._thread.join(timeout=5.0)
+
     # -- consumer side --
     def _drain(self) -> None:
         while True:
             root = self._q.get()
+            if root is _SHUTDOWN:
+                return
             if isinstance(root, threading.Event):
                 root.set()
                 continue
@@ -289,9 +296,29 @@ class OtlpExporter:
         }
 
 
+_SHUTDOWN = object()
 _exporter: Optional[OtlpExporter] = None
 _exporter_env_checked = False
 _exporter_lock = threading.Lock()
+_atexit_registered = False
+
+
+def _register_atexit() -> None:
+    """Drain the export queue at interpreter exit so spans completed just
+    before shutdown still reach the collector (daemon threads would
+    otherwise be killed mid-queue)."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    import atexit
+
+    def _flush_on_exit():
+        exp = _exporter
+        if exp is not None:
+            exp.flush(timeout_s=3.0)
+
+    atexit.register(_flush_on_exit)
+    _atexit_registered = True
 
 
 def configure_otlp(
@@ -302,14 +329,19 @@ def configure_otlp(
     ``.flush()``/``.exported``)."""
     global _exporter, _exporter_env_checked
     with _exporter_lock:
+        if _exporter is not None:
+            _exporter.shutdown()
         _exporter = OtlpExporter(endpoint, service_name=service_name)
         _exporter_env_checked = True
+        _register_atexit()
         return _exporter
 
 
 def disable_otlp() -> None:
     global _exporter, _exporter_env_checked
     with _exporter_lock:
+        if _exporter is not None:
+            _exporter.shutdown()
         _exporter = None
         _exporter_env_checked = True
 
@@ -330,6 +362,7 @@ def _get_exporter() -> Optional[OtlpExporter]:
                         "MOOSE_TPU_OTLP_SERVICE", "moose_tpu"
                     ),
                 )
+                _register_atexit()
     return _exporter
 
 

@@ -62,7 +62,19 @@ t = chain(body, x[None].repeat(3, 0).reshape(3, n, n), T=20)
 mb = 3 * n * n * 8 * 2
 print(f"rbg 128-bit bank draw ({mb/1e6:.0f} MB): {t*1e3:.3f} ms  {mb/t/1e9:.1f} GB/s")
 
-os.environ_bak = None
 ring.set_prf_impl("threefry")
 t = chain(body, x[None].repeat(3, 0).reshape(3, n, n), T=20)
 print(f"threefry 128-bit bank draw ({mb/1e6:.0f} MB): {t*1e3:.3f} ms  {mb/t/1e9:.1f} GB/s")
+
+from moose_tpu.dialects import pallas_prf
+
+
+def body_pallas(c, _):
+    seed = jnp.stack([c[0, 0, 0].astype(jnp.uint32), jnp.uint32(1),
+                      jnp.uint32(2), jnp.uint32(3)])
+    bits = pallas_prf.random_bits_u64(seed, (2, 3, n, n))
+    return c ^ bits[0] ^ bits[1], None
+
+
+t = chain(body_pallas, x[None].repeat(3, 0).reshape(3, n, n), T=20)
+print(f"pallas threefry 128-bit bank draw ({mb/1e6:.0f} MB): {t*1e3:.3f} ms  {mb/t/1e9:.1f} GB/s")

@@ -1,0 +1,21 @@
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import collections
+import re
+import sys
+import numpy as np
+import moose_tpu
+import jax, jax.numpy as jnp
+from moose_tpu.dialects import ring
+
+n = 1000
+rng = np.random.default_rng(0)
+a = rng.integers(0, 1<<64, (n,n), dtype=np.uint64)
+b = rng.integers(0, 1<<64, (n,n), dtype=np.uint64)
+
+f = jax.jit(lambda w,x,y,z: ring._matmul_u128(w,x,y,z))
+txt = f.lower(a,a,b,b).compile().as_text()
+ops = collections.Counter(re.findall(r"= \S+ (\w+)\(", txt))
+print(sys.argv[1] if len(sys.argv)>1 else "?", dict(ops.most_common(12)))
+tot_fusion = sum(1 for l in txt.splitlines() if "fusion(" in l)
+print("lines:", len(txt.splitlines()))

@@ -1,0 +1,130 @@
+"""The Pallas Threefry2x32-20 mask-expansion kernel (pallas_prf.py) and
+its wiring as the ``threefry-pallas`` PRF impl.
+
+On CPU the kernel runs in pallas interpret mode — the identical program,
+so these tests pin the exact stream TPU deployments produce (the
+property the protocol needs: parties holding a seed derive equal masks).
+
+Reference counterpart: AES-128-CTR mask expansion, host/prim.rs:113-133.
+"""
+
+import numpy as np
+import pytest
+
+import moose_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from moose_tpu.dialects import pallas_prf, ring
+
+
+def test_cipher_matches_jax_threefry2x32():
+    """The in-kernel round function is bit-for-bit Threefry2x32-20 as
+    implemented (and audited) in JAX itself."""
+    from jax._src.prng import threefry_2x32
+
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, 1 << 32, size=2, dtype=np.uint32)
+    c = rng.integers(0, 1 << 32, size=(2, 64), dtype=np.uint32)
+    ours0, ours1 = pallas_prf._threefry2x32_20(
+        jnp.asarray(c[0]), jnp.asarray(c[1]),
+        jnp.uint32(k[0]), jnp.uint32(k[1]),
+    )
+    # jax's threefry_2x32 splits its flat count into (first half = x0,
+    # second half = x1) and concatenates the outputs the same way
+    theirs = threefry_2x32(
+        (jnp.uint32(k[0]), jnp.uint32(k[1])),
+        jnp.asarray(np.concatenate([c[0], c[1]])),
+    )
+    assert np.array_equal(np.asarray(ours0), np.asarray(theirs)[:64])
+    assert np.array_equal(np.asarray(ours1), np.asarray(theirs)[64:])
+
+
+def test_deterministic_and_key_sensitive():
+    seed = np.array([9, 8, 7, 6], np.uint32)
+    a = np.asarray(pallas_prf.random_bits_u64(seed, (513, 257)))
+    b = np.asarray(pallas_prf.random_bits_u64(seed, (513, 257)))
+    assert np.array_equal(a, b)
+    seed2 = np.array([9, 8, 7, 5], np.uint32)
+    c = np.asarray(pallas_prf.random_bits_u64(seed2, (513, 257)))
+    assert not np.array_equal(a, c)
+    # every seed word matters (the key folds all four)
+    for i in range(4):
+        s = seed.copy()
+        s[i] ^= 1
+        d = np.asarray(pallas_prf.random_bits_u64(s, (513, 257)))
+        assert not np.array_equal(a, d), f"seed word {i} ignored"
+
+
+def test_shapes_and_uniformity():
+    seed = np.array([1, 2, 3, 4], np.uint32)
+    assert pallas_prf.random_bits_u64(seed, ()).shape == ()
+    assert pallas_prf.random_bits_u64(seed, (7,)).shape == (7,)
+    a = np.asarray(pallas_prf.random_bits_u64(seed, (200, 300)))
+    bits = np.unpackbits(a.view(np.uint8))
+    assert abs(bits.mean() - 0.5) < 2e-3
+    assert len(np.unique(a)) == a.size  # no counter reuse
+    # a flat draw is the prefix of a larger draw ONLY in the same call —
+    # different shapes share the counter space deterministically
+    b = np.asarray(pallas_prf.random_bits_u64(seed, (60000,)))
+    assert np.array_equal(a.reshape(-1), b[: a.size])
+
+
+def test_ring_prf_impl_secure_dot_roundtrip():
+    """The full secure dot is correct under threefry-pallas masks, and
+    the zero-share still telescopes to zero."""
+    from moose_tpu.parallel import spmd
+
+    ring.set_prf_impl("threefry-pallas")
+    try:
+        mk = np.arange(4, dtype=np.uint32) + 11
+        rng = np.random.default_rng(3)
+        a = rng.normal(size=(24, 24))
+        b = rng.normal(size=(24, 24))
+
+        @jax.jit
+        def secure_dot(master_key, x_f, y_f):
+            sess = spmd.SpmdSession(master_key)
+            xs = spmd.fx_encode_share(sess, x_f, 14, 23, 128)
+            ys = spmd.fx_encode_share(sess, y_f, 14, 23, 128)
+            z = spmd.fx_dot(sess, xs, ys)
+            return spmd.fx_reveal_decode(z)
+
+        out = np.asarray(secure_dot(mk, a, b))
+        assert np.abs(out - a @ b).max() < 1e-4
+
+        sess = spmd.SpmdSession(mk)
+        alpha_lo, alpha_hi = spmd.zero_share(sess, (5, 5), 128)
+        total = np.zeros((5, 5), np.uint64)
+        for i in range(3):  # wrapping u64 accumulation
+            total = total + np.asarray(alpha_lo)[i]
+        assert (total == 0).all()
+    finally:
+        ring.set_prf_impl("rbg")
+
+
+def test_distributed_accepts_threefry_pallas(monkeypatch):
+    # test_distributed sets the weak-PRF escape hatch process-wide;
+    # clear it so the rbg rejection below is exercised for real
+    monkeypatch.delenv("MOOSE_TPU_ALLOW_WEAK_PRF", raising=False)
+    ring.set_prf_impl("threefry-pallas")
+    try:
+        ring.require_strong_prf("test")  # must not raise
+    finally:
+        ring.set_prf_impl("rbg")
+    with pytest.raises(Exception):
+        ring.require_strong_prf("test")
+
+
+def test_bits_sampling_is_binary():
+    ring.set_prf_impl("threefry-pallas")
+    try:
+        lo, hi = ring.sample_bits_seeded(
+            (50, 50), np.array([1, 2, 3, 4], np.uint32), 128
+        )
+        a = np.asarray(lo)
+        assert set(np.unique(a)) <= {0, 1}
+        assert 0.4 < a.mean() < 0.6
+        assert not np.asarray(hi).any()
+    finally:
+        ring.set_prf_impl("rbg")

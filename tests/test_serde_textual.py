@@ -273,3 +273,36 @@ def test_native_parser_matches_python():
     # malformed lines surface the same class of error
     with pytest.raises(Exception):
         parse_computation("x = Nope(", force_native=True)
+
+
+def test_value_wire_codec_roundtrip_shapes_and_dtypes():
+    """The runtime VALUE codec (raw little-endian ndarray bytes) preserves
+    shape — including 0-d, where np.ascontiguousarray silently promotes
+    to 1-d (regression: scalars came back as (1,)) — and dtype."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from moose_tpu import dtypes as dt
+    from moose_tpu.serde import deserialize_value, serialize_value
+    from moose_tpu.values import HostRingTensor, HostTensor
+
+    for arr in (
+        np.float64(32.0),
+        np.ones(()),
+        np.ones((1,)),
+        np.ones((0, 3)),
+        np.arange(6.0).reshape(2, 3),
+        np.arange(6.0).reshape(2, 3)[:, ::2],  # non-contiguous
+    ):
+        v = HostTensor(jnp.asarray(arr), "alice", dt.float64)
+        out = deserialize_value(serialize_value(v), "bob")
+        got = np.asarray(out.value)
+        assert got.shape == np.asarray(arr).shape, arr
+        assert np.array_equal(got, np.asarray(arr)), arr
+
+    ring = HostRingTensor(
+        jnp.asarray(np.uint64(7)), jnp.asarray(np.uint64(1)), 128, "alice"
+    )
+    out = deserialize_value(serialize_value(ring), "bob")
+    assert np.asarray(out.lo).shape == ()
+    assert int(out.lo) == 7 and int(out.hi) == 1 and out.width == 128

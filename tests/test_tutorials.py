@@ -55,3 +55,10 @@ def test_ml_inference_with_onnx_tutorial():
 def test_interfacing_textual_and_cli_tutorial():
     out = _run("interfacing_textual_and_cli.py")
     assert "OK — dasher computed" in out
+
+
+@pytest.mark.slow
+def test_multichip_spmd_tutorial():
+    out = _run("multichip_spmd.py")
+    assert "multichip SPMD tutorial OK" in out
+    assert "'all-to-all': 0" in out

@@ -1,0 +1,137 @@
+"""Use case: scaling the 3-party protocol over a TPU device mesh.
+
+The TPU-native execution layout this framework adds beyond the
+reference: instead of three worker processes exchanging shares over gRPC
+(`/root/reference/moose/src/choreography/`), a single-controller XLA
+program runs all three parties as a ``parties`` axis of a
+``jax.sharding.Mesh``, with resharing lowered to ``collective-permute``
+over ICI links and the batch dimension data-parallel over the remaining
+devices.  The protocol math is identical — the network is the mesh.
+
+What this demonstrates, end to end:
+
+1. party-stacked sharings (``spmd.SpmdRep``: one array, leading axes
+   ``(party=3, slot=2)``) and the fixed-point layer on top;
+2. building a ``(parties, data)`` mesh and constraining shares to it;
+3. a secure logistic-regression training step AND a secure softmax
+   (bit-decomposition comparisons, Goldschmidt division — the nonlinear
+   protocol library of ``parallel/spmd_math.py``) jitted over the mesh;
+4. inspecting the compiled HLO to verify the collective mix: party
+   exchanges ride ``collective-permute`` (neighbor hops), sharded
+   contractions reduce with ``all-reduce``, and nothing degenerates to
+   ``all-to-all``.
+
+Run on any machine (8 virtual CPU devices stand in for a TPU slice):
+
+    python tutorials/multichip_spmd.py
+
+On a real v5e-8 the same code runs unchanged: 6 of the 8 chips form a
+(3, 2) mesh — each party owns two chips, shares never co-reside.
+"""
+
+import os
+import pathlib as _pathlib
+import sys as _sys
+
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+N_DEVICES = 6
+
+# the mesh must exist before jax initializes its backend
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={N_DEVICES}"
+    ).strip()
+
+import numpy as np
+
+import moose_tpu  # noqa: F401  (x64 + dialect registration)
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")  # tutorial: virtual devices
+
+from moose_tpu.parallel import spmd
+from moose_tpu.parallel import spmd_math as sm
+
+I, F, W = 14, 23, 128
+
+
+def main():
+    # ---- 1. a (parties=3, data=2) mesh over 6 devices -----------------
+    mesh = spmd.make_mesh(N_DEVICES)
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    rng = np.random.default_rng(0)
+    batch = 8 * mesh.devices.shape[1]
+    x = rng.normal(size=(batch, 16)) * 0.4
+    true_w = rng.normal(size=(16, 1))
+    y = (x @ true_w > 0).astype(np.float64)
+    w0 = np.zeros((16, 1))
+    mk = np.frombuffer(b"tutorial-masterk", dtype=np.uint32)
+
+    # ---- 2+3. secure training step + softmax, jitted over the mesh ----
+    def train_and_infer(master_key, x_f, y_f, w_f):
+        sess = spmd.SpmdSession(master_key)
+        xs = spmd.fx_encode_share(sess, x_f, I, F, W)
+        ys = spmd.fx_encode_share(sess, y_f, I, F, W)
+        ws = spmd.fx_encode_share(sess, w_f, I, F, W)
+        # shares are CONSTRAINED onto the mesh: party axis -> 'parties',
+        # batch axis -> 'data' (spmd.rep_sharding builds the spec)
+        w1 = spmd.logreg_train_step(sess, xs, ys, ws, lr=0.5, mesh=mesh)
+        logits = spmd.fx_dot(sess, xs, w1)
+        two_col = sm.fx_softmax(
+            sess,
+            spmd.SpmdFixed(
+                spmd.concat([logits.tensor, spmd.neg(logits.tensor)], 1),
+                I, F,
+            ),
+            axis=1,
+        )
+        return spmd.fx_reveal_decode(w1), spmd.fx_reveal_decode(two_col)
+
+    with mesh:
+        compiled = jax.jit(train_and_infer).lower(mk, x, y, w0).compile()
+        w1, probs = compiled(mk, x, y, w0)
+    w1, probs = np.asarray(w1), np.asarray(probs)
+
+    # the revealed results match the same step on plaintext floats
+    z = x @ w0
+    preds = 0.5 + 0.19828547 * z - 0.00446928 * z**3  # protocol sigmoid
+    w_plain = w0 - 0.5 * x.T @ (preds - y) / batch
+    assert np.abs(w1 - w_plain).max() < 1e-3, "training step diverged"
+    corr = np.corrcoef(w1.ravel(), true_w.ravel())[0, 1]
+    print(f"one secure SGD step: max |Δw vs plaintext| = "
+          f"{np.abs(w1 - w_plain).max():.2e}, corr(w, w_true) = {corr:.2f}")
+
+    logits1 = x @ w1
+    want = np.asarray(
+        jax.nn.softmax(np.concatenate([logits1, -logits1], 1), axis=1)
+    )
+    print(f"secure softmax: max err vs plaintext = "
+          f"{np.abs(probs - want).max():.2e}")
+    assert np.abs(probs - want).max() < 5e-2
+
+    # ---- 4. the collective mix is the proof of the layout -------------
+    hlo = (
+        "\n".join(
+            m.to_string() for m in compiled.runtime_executable().hlo_modules()
+        )
+        if hasattr(compiled, "runtime_executable")
+        else compiled.as_text()
+    )
+    counts = {
+        name: hlo.count(name)
+        for name in (
+            "collective-permute", "all-reduce", "all-gather", "all-to-all"
+        )
+    }
+    print(f"collective mix: {counts}")
+    assert counts["collective-permute"] > 0, "party axis must neighbor-hop"
+    assert counts["all-to-all"] == 0, "layout regressed to all-to-all"
+    print("multichip SPMD tutorial OK")
+
+
+if __name__ == "__main__":
+    main()
