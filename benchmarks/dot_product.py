@@ -20,6 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import moose_tpu as pm
+from moose_tpu.dialects import ring as _ring
 from moose_tpu.runtime import LocalMooseRuntime
 
 alice = pm.host_placement("alice")
@@ -232,8 +233,6 @@ def main():
                         help="run every reference table row")
     args = parser.parse_args()
     if args.prf:
-        from moose_tpu.dialects import ring as _ring
-
         _ring.set_prf_impl(args.prf)
 
 
@@ -250,8 +249,6 @@ def main():
         if ref is not None:
             result["reference_s"] = ref
             result["speedup"] = ref / result["median_s"]
-        from moose_tpu.dialects import ring as _ring
-
         result["prf"] = _ring.get_prf_impl()
         print(json.dumps(result), flush=True)
 

@@ -241,8 +241,6 @@ def main():
     )
     args = parser.parse_args()
     if args.prf:
-        from moose_tpu.dialects import ring as _ring
-
         _ring.set_prf_impl(args.prf)
 
     if args.engine == "spmd":

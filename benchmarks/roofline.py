@@ -152,13 +152,6 @@ def main():
         )
         return z_.tensor, None
 
-    def SpmdFixedInject(fx, c):
-        return spmd.SpmdFixed(
-            inject(fx.tensor, c),
-            fx.integral_precision,
-            fx.fractional_precision,
-        )
-
     phases = {
         "share_ms": _chain_time(body_share, c0, t_iters),
         "cross_products_ms": _chain_time(body_cross, c0, t_iters),

@@ -121,6 +121,14 @@ def random_bits_u64(seed_u32x4, shape) -> jax.Array:
     (threefry2x32-20, pallas-expanded on TPU; interpreted elsewhere)."""
     shape = tuple(int(s) for s in shape)
     n = int(np.prod(shape)) if shape else 1
+    if n > 1 << 32:
+        # the per-lane counter is 32-bit; beyond 2^32 lanes a block would
+        # silently repeat an earlier block's stream — in an MPC protocol
+        # that is mask reuse, so refuse instead of assuming
+        raise ValueError(
+            f"threefry-pallas draw of {n} lanes exceeds the 2^32 counter "
+            "space of one seed; split the draw across derived seeds"
+        )
     n_blocks = -(-n // _BLOCK)
     seed = jnp.asarray(seed_u32x4, dtype=U32)
     flat = _bits_flat(seed, n_blocks).reshape(-1)

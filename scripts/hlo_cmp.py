@@ -1,4 +1,4 @@
-import sys, os
+import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import collections
 import re
@@ -17,5 +17,5 @@ f = jax.jit(lambda w,x,y,z: ring._matmul_u128(w,x,y,z))
 txt = f.lower(a,a,b,b).compile().as_text()
 ops = collections.Counter(re.findall(r"= \S+ (\w+)\(", txt))
 print(sys.argv[1] if len(sys.argv)>1 else "?", dict(ops.most_common(12)))
-tot_fusion = sum(1 for l in txt.splitlines() if "fusion(" in l)
-print("lines:", len(txt.splitlines()))
+print("lines:", len(txt.splitlines()), "fusions:",
+      sum(1 for l in txt.splitlines() if "fusion(" in l))
