@@ -10,7 +10,10 @@ pymoose/pymoose/predictors/predictor.py:49-85).
       # circuit is ~200k host ops walked eagerly per worker)
 """
 
+import pathlib
 import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 import numpy as np
 

@@ -7,6 +7,11 @@ ONNX, score under 3-party replicated sharing.
 
 import numpy as np
 
+import pathlib as _pathlib
+import sys as _sys
+
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
 import moose_tpu as pm
 from moose_tpu import predictors
 from moose_tpu.predictors.sklearn_export import logistic_regression_onnx

@@ -19,6 +19,11 @@ AveragePool-only architectures for the fused path.
 
 import numpy as np
 
+import pathlib as _pathlib
+import sys as _sys
+
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
 import moose_tpu as pm
 from moose_tpu import predictors
 from moose_tpu.predictors.sklearn_export import resnet_block_onnx
