@@ -400,9 +400,15 @@ def sample_bits_seeded(shape, seed_u32x4, width: int):
         from . import pallas_prf
 
         # one u64 word yields 64 output bits — draw ceil(n/64) words and
-        # unpack, rather than burning a full cipher word per bit
+        # unpack, rather than burning a full cipher word per bit.
+        # Domain-separate from sample_uniform_seeded: flip a high key bit
+        # so a seed reused across a uniform draw and a bit draw can never
+        # yield correlated masks (the streams come from distinct keys).
         n = int(np.prod(shape)) if shape else 1
-        words = pallas_prf.random_bits_u64(seed_u32x4, (-(-n // 64),))
+        tagged = jnp.asarray(seed_u32x4, dtype=jnp.uint32) ^ jnp.asarray(
+            [0, 0, 0, 0x80000000], dtype=jnp.uint32
+        )
+        words = pallas_prf.random_bits_u64(tagged, (-(-n // 64),))
         shifts = jnp.arange(64, dtype=U64)
         bits = ((words[:, None] >> shifts) & jnp.uint64(1)).reshape(-1)
         lo = bits[:n].reshape(shape)
@@ -585,6 +591,12 @@ def _int8_pair_diags(la, lb, out_limbs: int, k: int):
     for s in range(out_limbs):
         i0 = max(0, s - (in_limbs - 1))
         i1 = min(s, in_limbs - 1)
+        if i1 < i0:
+            # no (i, j) pair sums to s (out_limbs > 2*in_limbs - 1);
+            # emit zeros like the pairs/s64 formulations do
+            m, n = la[0].shape[0], lb[0].shape[-1]
+            diags.append(jnp.zeros((m, n), dtype=U64))
+            continue
         npairs = i1 - i0 + 1
         a_sl = astack[i0:i1 + 1]  # (npairs, m, k)
         b0 = in_limbs - 1 - s + i0

@@ -283,6 +283,8 @@ def _encode_operation(op: Operation) -> dict:
         "input_types": input_types,
         "return_type": _encode_ty(op.signature.return_type),
     }
+    if op.signature.variadic:
+        sig["variadic"] = True
     base = {
         "name": op.name,
         "inputs": inputs,
@@ -437,6 +439,11 @@ def _decode_hook(obj: dict):
     if tag == "DType":
         return _decode_dtype(obj)
     if tag == "ndarray_raw":
+        # zero-copy view over the msgpack buffer — READ-ONLY.  Every
+        # Host* consumer immediately wraps it in jnp.asarray (device
+        # arrays are immutable by design, so no writability is lost);
+        # the one user-facing numpy path (RawNdarray) re-normalizes to
+        # a writable copy in deserialize_value.
         return np.frombuffer(obj["data"], dtype=obj["dtype"]).reshape(
             obj["shape"]
         )
@@ -524,7 +531,8 @@ def _decode_operation(obj: dict) -> Operation:
         kind=kind,
         inputs=inputs,
         placement_name=obj["placement_name"],
-        signature=Signature(input_types, return_type),
+        signature=Signature(input_types, return_type,
+                            variadic=bool(sig_obj.get("variadic", False))),
         attributes=attributes,
     )
 
