@@ -25,7 +25,8 @@ def typing_pass(comp: Computation) -> Computation:
             inputs=list(op.inputs),
             placement_name=op.placement_name,
             signature=Signature(
-                tuple(input_types), op.signature.return_type
+                tuple(input_types), op.signature.return_type,
+                variadic=op.signature.variadic,
             ),
             attributes=op.attributes,
         )

@@ -25,7 +25,13 @@ def well_formed_check(comp: Computation) -> Computation:
                 raise MalformedComputationError(
                     f"op {name}: unknown input {inp!r}"
                 )
-        if op.signature.arity != len(op.inputs):
+        if op.signature.variadic:
+            if not op.inputs:
+                raise MalformedComputationError(
+                    f"op {name}: variadic signature requires at least "
+                    "one input"
+                )
+        elif op.signature.arity != len(op.inputs):
             raise MalformedComputationError(
                 f"op {name}: signature arity {op.signature.arity} != "
                 f"{len(op.inputs)} inputs"

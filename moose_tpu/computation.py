@@ -191,6 +191,10 @@ def rep_fixed_ty(dtype: dt.DType) -> Ty:
 class Signature:
     input_types: tuple[Ty, ...]
     return_type: Ty
+    # variadic signatures (reference Signature::variadic,
+    # computation.rs:620-767) carry ONE element type that every input
+    # shares; textual form is ``[T] -> R`` and arity is unchecked
+    variadic: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "input_types", tuple(self.input_types))
@@ -200,6 +204,11 @@ class Signature:
         return len(self.input_types)
 
     def to_textual(self) -> str:
+        if self.variadic:
+            return (
+                f"[{self.input_types[0].to_textual()}] -> "
+                f"{self.return_type.to_textual()}"
+            )
         ins = ", ".join(t.to_textual() for t in self.input_types)
         return f"({ins}) -> {self.return_type.to_textual()}"
 

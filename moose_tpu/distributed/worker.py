@@ -231,7 +231,9 @@ def execute_role(
             return HostUnit(identity)
         if kind == "Output":
             value = env[op.inputs[0]]
-            outputs[op.name] = _to_user_value(value)
+            # keyed by the Output tag like the local executors and the
+            # reference (execution/asynchronous.rs:623)
+            outputs[op.attributes.get("tag", op.name)] = _to_user_value(value)
             return value
         args = [env[i] for i in op.inputs]
         return execute_kernel(sess, op, identity, args)

@@ -178,7 +178,10 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
             if not isinstance(value, HostUnit):
                 value = logical.to_host(sess, plc.name, value)
             env[name] = value
-            outputs[name] = value
+            # the reference keys result dicts by the Output tag, not the
+            # op name (execution/asynchronous.rs:623); fall back to the
+            # name for tag-less graphs
+            outputs[op.attributes.get("tag", name)] = value
             continue
         args = [env[i] for i in op.inputs]
         if trace_ops:

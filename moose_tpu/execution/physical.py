@@ -31,6 +31,7 @@ from ..values import (
     HostBitTensor,
     HostPrfKey,
     HostRingTensor,
+    HostSeed,
     HostShape,
     HostString,
     HostTensor,
@@ -45,6 +46,17 @@ def _fresh_key_words() -> np.ndarray:
 
 def _ring_width_of(ty_name: str) -> int:
     return 128 if "128" in ty_name else 64
+
+
+def _sample_from_seed(sess, plc, shp, seed, ret_name: str, attrs):
+    """Shared Sample/SampleSeeded dispatch: bit tensor vs bit-valued ring
+    (max_value == 1) vs uniform ring draw."""
+    if ret_name == "HostBitTensor":
+        return sess.sample_bit_tensor_seeded(plc, shp, seed)
+    width = _ring_width_of(ret_name)
+    if attrs.get("max_value") == 1:
+        return sess.sample_bits_seeded(plc, shp, seed, width)
+    return sess.sample_uniform_seeded(plc, shp, seed, width)
 
 
 def execute_kernel(sess: EagerSession, op, plc: str, args: list):
@@ -85,13 +97,15 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
     if kind == "DeriveSeed":
         return sess.derive_seed(plc, args[0], A["sync_key"])
     if kind == "SampleSeeded":
-        shp, seed = args[0], args[1]
-        if ret.name == "HostBitTensor":
-            return sess.sample_bit_tensor_seeded(plc, shp, seed)
-        width = _ring_width_of(ret.name)
-        if A.get("max_value") == 1:
-            return sess.sample_bits_seeded(plc, shp, seed, width)
-        return sess.sample_uniform_seeded(plc, shp, seed, width)
+        return _sample_from_seed(sess, plc, args[0], args[1], ret.name, A)
+    if kind == "Sample":
+        # eager/distributed fallback for unseeded draws; the plan-driven
+        # path feeds the fresh seed through `keys` instead
+        # (_run_physical_ops)
+        import jax.numpy as jnp
+
+        seed = HostSeed(jnp.asarray(_fresh_key_words()), plc)
+        return _sample_from_seed(sess, plc, args[0], seed, ret.name, A)
     if kind == "Add":
         return sess.add(plc, args[0], args[1])
     if kind == "Sub":
@@ -239,6 +253,14 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
         return sess.shl_dim(plc, args[0], A["amount"], A["bit_length"])
     if kind == "AtLeast2D":
         return sess.at_least_2d(plc, args[0], A.get("to_column_vector", False))
+    if kind == "Shape":
+        return sess.shape(plc, args[0])
+    if kind == "AddN":
+        # variadic sum (reference AddNOp, computation.rs Signature::variadic)
+        out = args[0]
+        for a in args[1:]:
+            out = sess.add(plc, out, a)
+        return out
     raise UnimplementedError(f"physical op {kind} ({op.name})")
 
 
@@ -287,6 +309,16 @@ def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
         if op.kind == "PrfKeyGen":
             env[n] = HostPrfKey(jnp.asarray(keys[n]), plc)
             continue
+        if op.kind == "Sample":
+            # unseeded draw (reference SampleOp): fresh 128-bit seed per
+            # evaluation, fed like PrfKeyGen keys so the jitted program
+            # stays reusable
+            env[n] = _sample_from_seed(
+                sess, plc, env[op.inputs[0]],
+                HostSeed(jnp.asarray(keys[n]), plc),
+                op.signature.return_type.name, op.attributes,
+            )
+            continue
         if op.kind in ("Input", "Load"):
             env[n] = _lift_array(dyn[n], op, plc)
             continue
@@ -303,7 +335,9 @@ def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
         if op.kind == "Output":
             value = env[op.inputs[0]]
             env[n] = value
-            outputs[n] = value
+            # keyed by Output tag like the reference's executor
+            # (execution/asynchronous.rs:623); op name when untagged
+            outputs[op.attributes.get("tag", n)] = value
             continue
         args = [env[i] for i in op.inputs]
         if trace_ops:
@@ -327,7 +361,10 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
     if any(comp.operations[n].kind in _DYNAMIC_SHAPE_KINDS for n in order):
         use_jit = False
 
-    key_ops = [n for n in order if comp.operations[n].kind == "PrfKeyGen"]
+    key_ops = [
+        n for n in order
+        if comp.operations[n].kind in ("PrfKeyGen", "Sample")
+    ]
     dyn_names: list[str] = []
     static_env: dict[str, Any] = {}
     for n in order:

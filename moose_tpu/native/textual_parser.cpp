@@ -184,6 +184,25 @@ void pack_attr_value(Pack* p, const std::string& raw) {
     for (const auto& part : parts) pack_attr_value(p, part);
     return;
   }
+  // 128-bit sync/rendezvous keys print as bare 32-char hex
+  // (computation.rs RendezvousKey/SyncKey Display); forward raw so the
+  // Python grammar decodes them key-aware as bytes — a digit-only key
+  // would otherwise parse as a decimal integer below
+  if (v.size() == 32) {
+    bool all_hex = true;
+    for (char ch : v) {
+      if (!std::isxdigit(static_cast<unsigned char>(ch))) {
+        all_hex = false;
+        break;
+      }
+    }
+    if (all_hex) {
+      p->map_header(1);
+      p->str("__raw__", 7);
+      p->str(v);
+      return;
+    }
+  }
   // integer / float (decimal only: 0x... payloads are bytes in the
   // grammar, and strtod would otherwise read them as hex floats)
   bool numeric_lead =

@@ -174,6 +174,17 @@ class LocalMooseRuntime:
             return self._physical.evaluate(
                 compiled, self.storage, arguments, use_jit=use_jit
             )
+        if any(
+            op.kind in self._LOWERED_KINDS
+            for op in computation.operations.values()
+        ):
+            # already-lowered host-level graphs (e.g. the reference's
+            # *-compiled.moose artifacts parsed from textual) carry ring
+            # ops the logical dialect doesn't know; execute them on the
+            # physical interpreter like evaluate_compiled does
+            return self._physical.evaluate(
+                computation, self.storage, arguments, use_jit=use_jit
+            )
         return self._interpreter.evaluate(
             computation, self.storage, arguments, use_jit=use_jit
         )

@@ -267,8 +267,21 @@ class _Cursor:
         raise MalformedComputationError(f"unbalanced {open_ch}")
 
 
+# Short type names used by older reference artifacts (e.g.
+# moose/benches/rep_computation.moose) for the host-prim types
+# (host/prim.rs); canonicalized to the Host-qualified names
+_TY_ALIASES = {
+    "PrfKey": "HostPrfKey",
+    "Seed": "HostSeed",
+    "Unit": "HostUnit",
+    "Shape": "HostShape",
+    "String": "HostString",
+}
+
+
 def _parse_ty(cur: _Cursor) -> Ty:
     name = cur.ident()
+    name = _TY_ALIASES.get(name, name)
     if cur.peek() == "<":
         cur.expect("<")
         tok = cur.ident()
@@ -355,9 +368,32 @@ def _parse_attr_value(cur: _Cursor):
     if ident.startswith("Fixed") and cur.peek() == "(":
         inner = cur.balanced("(", ")")
         return _parse_dtype_token(f"{ident}({inner})")
+    if ident in ("Ring64", "Ring128", "Bit") and cur.peek() == "(":
+        # scalar ring/bit constants (Fill payloads, computation.rs
+        # Constant enum): plain python ints keep arbitrary precision
+        inner = cur.balanced("(", ")")
+        return int(inner.strip())
     if cur.peek() == "(":
         return _parse_tensor_literal(cur, ident)
     raise MalformedComputationError(f"cannot parse attr value {ident!r}")
+
+
+# sync_key / rendezvous_key print as bare 128-bit hex in the reference's
+# textual format (computation.rs:30-93 RendezvousKey / SyncKey Display)
+_BARE_HEX_RE = re.compile(r"([0-9a-fA-F]{32})(?![0-9a-zA-Z_])")
+
+
+def _normalize_key_bytes(key: str, value):
+    """Canonicalize 128-bit key attributes to bytes: older artifacts
+    print sync_key as a byte list ``[148, 8, ...]``, newer ones as bare
+    hex; both mean the same 16 bytes."""
+    if key not in ("sync_key", "rendezvous_key"):
+        return value
+    if isinstance(value, (tuple, list)) and all(
+        isinstance(x, int) and 0 <= x < 256 for x in value
+    ):
+        return bytes(value)
+    return value
 
 
 def _parse_attrs(cur: _Cursor) -> dict:
@@ -370,12 +406,20 @@ def _parse_attrs(cur: _Cursor) -> dict:
         key = cur.ident()
         cur.expect("=")
         cur.ws()
+        m_hex = (
+            _BARE_HEX_RE.match(cur.s, cur.i)
+            if key in ("sync_key", "rendezvous_key")
+            else None
+        )
         if cur.s.startswith("0x", cur.i):
             m = re.match(r"0x([0-9a-fA-F]+)", cur.s[cur.i:])
             attrs[key] = bytes.fromhex(m.group(1))
             cur.i += m.end()
+        elif m_hex:
+            attrs[key] = bytes.fromhex(m_hex.group(1))
+            cur.i = m_hex.end()
         else:
-            attrs[key] = _parse_attr_value(cur)
+            attrs[key] = _normalize_key_bytes(key, _parse_attr_value(cur))
         if cur.peek() == ",":
             cur.expect(",")
 
@@ -416,16 +460,24 @@ def _parse_line(line: str, comp: Computation):
     kind = cur.ident()
     attrs = _parse_attrs(cur) if cur.peek() == "{" else {}
     cur.expect(":")
-    sig_in_inner = cur.balanced("(", ")")
     input_types = []
-    if sig_in_inner.strip():
-        sub = _Cursor(sig_in_inner)
-        while True:
-            input_types.append(_parse_ty(sub))
-            if sub.peek() == ",":
-                sub.expect(",")
-            else:
-                break
+    variadic = False
+    if cur.peek() == "[":
+        # reference variadic form (computation.rs:620-767):
+        # ``[T] -> R`` — one shared element type, any input count
+        variadic = True
+        sig_in_inner = cur.balanced("[", "]")
+        input_types.append(_parse_ty(_Cursor(sig_in_inner)))
+    else:
+        sig_in_inner = cur.balanced("(", ")")
+        if sig_in_inner.strip():
+            sub = _Cursor(sig_in_inner)
+            while True:
+                input_types.append(_parse_ty(sub))
+                if sub.peek() == ",":
+                    sub.expect(",")
+                else:
+                    break
     cur.expect("->")
     ret_ty = _parse_ty(cur)
     ins_inner = cur.balanced("(", ")")
@@ -437,7 +489,8 @@ def _parse_line(line: str, comp: Computation):
             kind=kind,
             inputs=inputs,
             placement_name=plc_name,
-            signature=Signature(tuple(input_types), ret_ty),
+            signature=Signature(tuple(input_types), ret_ty,
+                                variadic=variadic),
             attributes=attrs,
         )
     )
@@ -473,28 +526,35 @@ def parse_computation(text: str, force_native: Optional[bool] = None
     return comp
 
 
-def _resolve_native_attr(value):
+def _resolve_native_attr(value, key: str = ""):
     """Finish an attribute from the native parser: raw sub-expressions
     (dtype tokens, tensor literals) go through the Python grammar; lists
-    become the tuples the Python parser produces."""
+    become the tuples the Python parser produces.  ``key`` canonicalizes
+    128-bit key attributes exactly like the Python grammar does."""
     if isinstance(value, dict):
         if "__raw__" in value and len(value) == 1:
-            return _parse_attr_or_hex(value["__raw__"])
+            return _parse_attr_or_hex(value["__raw__"], key)
         raise MalformedComputationError(
             f"unexpected native attr payload {value!r}"
         )
     if isinstance(value, list):
-        return tuple(_resolve_native_attr(v) for v in value)
-    return value
+        return _normalize_key_bytes(
+            key, tuple(_resolve_native_attr(v) for v in value)
+        )
+    return _normalize_key_bytes(key, value)
 
 
-def _parse_attr_or_hex(src: str):
+def _parse_attr_or_hex(src: str, key: str = ""):
     cur = _Cursor(src)
     if src.startswith("0x"):
         m = re.match(r"0x([0-9a-fA-F]+)$", src)
         if m:
             return bytes.fromhex(m.group(1))
-    return _parse_attr_value(cur)
+    if key in ("sync_key", "rendezvous_key"):
+        m = _BARE_HEX_RE.fullmatch(src)
+        if m:
+            return bytes.fromhex(m.group(1))
+    return _normalize_key_bytes(key, _parse_attr_value(cur))
 
 
 def _assemble_from_records(records) -> Computation:
@@ -525,7 +585,7 @@ def _assemble_from_records(records) -> Computation:
                 _parse_line(rec["__line__"], comp)
                 continue
             attrs = {
-                k: _resolve_native_attr(v) for k, v in rec["a"].items()
+                k: _resolve_native_attr(v, k) for k, v in rec["a"].items()
             }
             comp.add_operation(
                 Operation(
