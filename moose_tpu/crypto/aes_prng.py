@@ -1,15 +1,34 @@
 """AES-128-CTR pseudo-random generator matching the reference's
-``aes_prng::AesRng`` construction (``host/prim.rs:5`` imports it; the
-crate generates the keystream as AES-128 encryptions of an incrementing
-128-bit little-endian counter starting at zero, consumed as
-little-endian words).
+``aes_prng::AesRng`` construction.
+
+Vendored consumption algorithm, with sources:
+
+- Crate: ``aes-prng ~0.2`` (tf-encrypted/aes-prng on crates.io),
+  pinned by ``/root/reference/moose/Cargo.toml:40`` and imported at
+  ``host/prim.rs:5``.  The crate's RNG is AES-128 in counter mode: the
+  keystream is AES-128_k(counter) for an incrementing 128-bit
+  little-endian counter starting at zero, with the 16-byte seed used
+  directly as the AES key; output bytes are consumed in keystream
+  order, words little-endian.
+- Draw orders are the reference's own kernels, not the crate:
+  ``next_u64`` consumes 8 keystream bytes LE; ring128 elements draw the
+  HIGH limb first per element (``(next_u64 << 64) + next_u64``,
+  ``host/ops.rs:2000``); bit draws consume one keystream byte's low bit
+  each (``get_bit``, ``host/ops.rs`` bit_kernel).
+
+Layers already pinned by official vectors (``tests/test_prf_compat.py``
+against ``tests/prf_golden.json``): the AES-128 block cipher (FIPS-197)
+and blake3 (official test vectors).  The COMPOSED stream (counter
+layout + word/bit granularity above) has no Rust-extracted vectors yet
+because this environment ships no cargo toolchain; it is one command
+from closed — run ``scripts/extract_prf_golden.rs`` on any machine with
+Rust and feed its JSON to ``scripts/check_prf_golden.py``, which
+verifies every stream bit-for-bit and localizes any divergence to the
+exact consumption rule.
 
 The block cipher is the repo's FIPS-197-validated numpy AES
-(``dialects/aes.py``); this module only adds the counter-mode stream and
-the draw order the reference's sampling kernels use
-(``host/ops.rs:1959-2040``): ``next_u64`` consumes 8 keystream bytes LE;
-ring128 elements draw HIGH limb first; bits consume one keystream byte's
-low bit per draw (``get_bit``).
+(``dialects/aes.py``); this module only adds the counter-mode stream
+and the reference draw orders.
 """
 
 from __future__ import annotations

@@ -27,6 +27,8 @@ byte b.  AES-GCM decryption of one 128-bit block: the keystream block is
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from .. import dtypes as dt
@@ -282,6 +284,91 @@ class RepBitOps:
         return rep_ops.bit_compose(self.sess, self.rep, bits, 128)
 
 
+class StackedBitOps:
+    """Party-stacked bit backend (VERDICT r4 #4): bit values are
+    ``spmd_math.SpmdBits`` arrays (3, 2, *wires, *elem) — every XOR is
+    one fused elementwise op over all parties, every AND one
+    ``bits_and`` (single reshare roll), and the whole AES circuit jits
+    into ONE XLA program instead of the per-host RepBitOps walk."""
+
+    def __init__(self, sess):
+        self.sess = sess  # SpmdSession
+
+    def xor(self, x, y):
+        from ..parallel import spmd_math as sm
+
+        return sm.bits_xor(x, y)
+
+    def and_(self, x, y):
+        from ..parallel import spmd_math as sm
+
+        return sm.bits_and(self.sess, x, y)
+
+    def not_(self, x):
+        from ..parallel import spmd_math as sm
+
+        return sm.bits_not(x)
+
+    def expand0(self, x, axis):
+        import jax.numpy as jnp
+
+        from ..parallel import spmd
+        from ..parallel.spmd_math import SpmdBits
+
+        return SpmdBits(
+            jnp.expand_dims(x.arr, spmd._laxis(x.arr, axis, extra=1))
+        )
+
+    def concat0(self, xs):
+        import jax.numpy as jnp
+
+        from ..parallel.spmd_math import SpmdBits
+
+        return SpmdBits(jnp.concatenate([x.arr for x in xs], axis=2))
+
+    def stack(self, xs):
+        import jax.numpy as jnp
+
+        from ..parallel.spmd_math import SpmdBits
+
+        return SpmdBits(jnp.stack([x.arr for x in xs], axis=2))
+
+    def slice0(self, x, b, e):
+        from ..parallel.spmd_math import SpmdBits
+
+        return SpmdBits(x.arr[:, :, b:e])
+
+    def take0(self, x, idx):
+        from ..parallel.spmd_math import SpmdBits
+
+        return SpmdBits(x.arr[:, :, np.asarray(idx)])
+
+    def index2(self, x, i, j):
+        from ..parallel.spmd_math import SpmdBits
+
+        return SpmdBits(x.arr[:, :, i, j])
+
+    def _ndim(self, x) -> int:
+        return x.arr.ndim - 2
+
+    def xor_public(self, x, mask: np.ndarray):
+        """XOR with a public constant into share b_0 (pair slots (0, 0)
+        and (2, 1)), mirroring spmd_math.bits_not."""
+        from ..parallel.spmd_math import SpmdBits
+
+        m = mask.reshape(
+            mask.shape + (1,) * (self._ndim(x) - mask.ndim)
+        ).astype(np.uint8)
+        arr = x.arr.at[0, 0].set(x.arr[0, 0] ^ m)
+        arr = arr.at[2, 1].set(arr[2, 1] ^ m)
+        return SpmdBits(arr)
+
+    def compose_ring128(self, bits):
+        from ..parallel import spmd_math as sm
+
+        return sm.bit_compose(self.sess, bits, 128)
+
+
 # ---------------------------------------------------------------------------
 # Bit-plane circuit
 # ---------------------------------------------------------------------------
@@ -515,6 +602,41 @@ def decrypt_host(sess, h: str, key, ciphertext, op) -> HostFixedTensor:
     return HostFixedTensor(ring, integ, frac)
 
 
+@dataclasses.dataclass
+class StackedAesKey:
+    """AES key bit-shared in the party-stacked layout (SpmdBits with
+    leading wire axis 128)."""
+
+    bits: object  # spmd_math.SpmdBits
+
+
+def decrypt_stacked(spmd_sess, op, key, ciphertext):
+    """Decrypt under MPC in the party-stacked layout: same algebraic
+    bit-plane circuit as :func:`decrypt_rep`, but every AND is one
+    ``bits_and`` over (3, 2, ...) stacks and the whole AES-GCM block
+    jits into one XLA program (VERDICT r4 #4 — the fast path for
+    encrypted-input inference)."""
+    from ..parallel import spmd_math as sm
+    from ..parallel.spmd import SpmdFixed
+
+    if isinstance(key, HostAesKey):
+        key_bits = sm.share_bits(spmd_sess, key.bits.value)
+    elif isinstance(key, StackedAesKey):
+        key_bits = key.bits
+    else:
+        raise TypeMismatchError(f"Decrypt key: {type(key).__name__}")
+    if not isinstance(ciphertext, AesTensor):
+        raise TypeMismatchError(
+            f"Decrypt ciphertext: {type(ciphertext).__name__}"
+        )
+    nonce = sm.share_bits(spmd_sess, ciphertext.nonce_bits.value)
+    cipher = sm.share_bits(spmd_sess, ciphertext.cipher_bits.value)
+    B = StackedBitOps(spmd_sess)
+    ring = aesgcm_decrypt_block(B, key_bits, nonce, cipher)
+    integ, frac = _ret_precision(op)
+    return SpmdFixed(ring, integ, frac)
+
+
 def decrypt_rep(sess, rep, key, ciphertext, op) -> RepFixedTensor:
     """Decrypt under MPC (encrypted/ops.rs rep_kernel): the plaintext is
     never revealed — the ciphertext bits are shared and AES runs on
@@ -589,7 +711,9 @@ def lift_input(sess, comp, op, arr, plc):
     from . import logical
 
     ret = op.signature.return_type
-    bits = jnp.asarray(np.asarray(arr)).astype(jnp.uint8)
+    # jnp.asarray directly: `arr` may be a jit tracer (the lift runs
+    # inside the traced plan core)
+    bits = jnp.asarray(arr).astype(jnp.uint8)
     plc_obj = comp.placements[plc]
     if ret.name == "AesTensor":
         if bits.shape[0] != 224:

@@ -130,6 +130,21 @@ def bind_placements(sess, comp: Computation):
     sess._placements = comp.placements
 
 
+def make_session(master_key, key_domain: int = 0):
+    """Dialect hook (see execution/interpreter.py): the logical dialect
+    executes against a plain EagerSession."""
+    from ..execution.session import EagerSession
+
+    return EagerSession(master_key=master_key, key_domain=key_domain)
+
+
+def lift_aes_input(sess, comp, op, arr, plc_name: str):
+    """Dialect hook: AES boundary values lift via the aes module."""
+    from . import aes
+
+    return aes.lift_input(sess, comp, op, arr, plc_name)
+
+
 def _rep_placement_of(sess, name: str) -> ReplicatedPlacement:
     plc = sess._placements[name]
     if not isinstance(plc, ReplicatedPlacement):
@@ -256,6 +271,21 @@ _REP_MATH = {
     "Log2": fx.log2,
     "Sqrt": fx.sqrt,
     "Sigmoid": fx.sigmoid,
+}
+
+# Rough lowered-size weights for replicated-placement math ops, in
+# host-op equivalents (measured on fixed(24,40)/ring128: a comparison's
+# bit-decompose + Kogge-Stone adder is ~900 host ops, Goldschmidt
+# division ~4k, shifted pow2 ~4.5k, softmax ~11k).  Consumers: the
+# runtime's auto-lowering decision and the stacked dialect's
+# effective-program-size estimate for the TPU heavy-jit gate.
+EXPANSION_WEIGHTS = {
+    "Softmax": 11000, "Sqrt": 13500, "Log": 9500, "Log2": 9500,
+    "Div": 4100, "Inverse": 4100, "Exp": 4600, "Sigmoid": 4600,
+    "Pow2": 4600, "Argmax": 3000, "MaxPool2D": 3000,
+    "Maximum": 2000, "Less": 950, "Greater": 950, "Equal": 1200,
+    "Sign": 950, "Abs": 1000, "Relu": 1000, "Mux": 200,
+    "Dot": 170, "Mul": 130, "Conv2D": 250,
 }
 
 

@@ -67,7 +67,8 @@ def _is_static_scalar(ty_name: str) -> bool:
 
 def build_plan(comp: Computation, arguments: dict, use_jit: bool,
                segment_limit: Optional[int] = None,
-               jit_segments: bool = True) -> _Plan:
+               jit_segments: bool = True, dialect=None) -> _Plan:
+    dialect = dialect if dialect is not None else logical
     order = comp.toposort_names()
     static_env: dict[str, Any] = {}
     dynamic_names: list[str] = []
@@ -119,15 +120,16 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
     limit = segment_limit if segment_limit is not None else _segment_limit()
     if use_jit and len(order) > limit:
         return _build_segmented_plan(
-            comp_ref, order, static_env, dynamic_names, limit, jit_segments
+            comp_ref, order, static_env, dynamic_names, limit, jit_segments,
+            dialect,
         )
 
     def core(master_key, dyn: dict):
         comp = comp_ref()
         if comp is None:  # pragma: no cover - defensive
             raise RuntimeError("computation was garbage-collected")
-        sess = EagerSession(master_key=master_key)
-        logical.bind_placements(sess, comp)
+        sess = dialect.make_session(master_key)
+        dialect.bind_placements(sess, comp)
         env: dict[str, Any] = {}
         outputs: dict[str, Any] = {}
         # dict keyed by (placement, storage key) so the returned structure is
@@ -135,7 +137,7 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
         saves: dict[tuple[str, str], Any] = {}
         _run_ops(
             sess, comp, order, static_env, env, outputs, saves, dyn,
-            trace_ops,
+            trace_ops, dialect,
         )
         return outputs, saves
 
@@ -143,9 +145,12 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
 
 
 def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
-             trace_ops=False):
+             trace_ops=False, dialect=None):
     """Execute ``names`` in order against ``env`` — the single op-walk
-    shared by the whole-graph core and the per-segment cores."""
+    shared by the whole-graph core and the per-segment cores.  ``dialect``
+    selects the execution layout (per-host ``dialects.logical`` by
+    default; ``dialects.stacked`` for the party-stacked SPMD backend)."""
+    dialect = dialect if dialect is not None else logical
     for name in names:
         op = comp.operations[name]
         plc = comp.placement_of(op)
@@ -158,9 +163,9 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
             from ..computation import AES_TY_NAMES
 
             if ret_name in AES_TY_NAMES:
-                from ..dialects import aes
-
-                env[name] = aes.lift_input(sess, comp, op, arr, plc.name)
+                env[name] = dialect.lift_aes_input(
+                    sess, comp, op, arr, plc.name
+                )
             else:
                 env[name] = _lift_array(arr, op, plc.name)
             continue
@@ -169,14 +174,14 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
             assert isinstance(key, HostString), (
                 f"Save key must be a string, found {type(key).__name__}"
             )
-            value = logical.to_host(sess, plc.name, env[op.inputs[1]])
+            value = dialect.to_host(sess, plc.name, env[op.inputs[1]])
             saves[(plc.name, key.value)] = value
             env[name] = HostUnit(plc.name)
             continue
         if op.kind == "Output":
             value = env[op.inputs[0]]
             if not isinstance(value, HostUnit):
-                value = logical.to_host(sess, plc.name, value)
+                value = dialect.to_host(sess, plc.name, value)
             env[name] = value
             # the reference keys result dicts by the Output tag, not the
             # op name (execution/asynchronous.rs:623); fall back to the
@@ -195,10 +200,10 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
 
             with telemetry.span(f"op:{op.kind}"):
                 env[name] = jax.block_until_ready(
-                    logical.execute_op(sess, comp, op, args)
+                    dialect.execute_op(sess, comp, op, args)
                 )
         else:
-            env[name] = logical.execute_op(sess, comp, op, args)
+            env[name] = dialect.execute_op(sess, comp, op, args)
 
 
 def heavy_jit_gate(n_ops: int, use_jit: bool) -> bool:
@@ -217,8 +222,14 @@ def heavy_jit_gate(n_ops: int, use_jit: bool) -> bool:
     promoted to pure jit when it validates.  Only the distributed WORKER
     scheduler (``distributed/worker.execute_role``) keeps plain eager
     behavior — its outputs are spread across workers, so no single
-    process can compare them."""
-    if not use_jit or n_ops <= _segment_limit():
+    process can compare them.
+
+    The gate threshold is independent of MOOSE_TPU_JIT_SEGMENT:
+    disabling segmentation (=0) means "one fused program", not "trust
+    the experimental backend" — the miscompile threshold is a hardware
+    property (~2000 host-op equivalents), so only the explicit
+    MOOSE_TPU_TPU_JIT_HEAVY=1 opt-out bypasses validation."""
+    if not use_jit or n_ops <= min(_segment_limit(), 2000):
         return use_jit
     import os
 
@@ -392,7 +403,7 @@ class _SelfCheckRunner(_SelfCheckBase):
     key domains, op walk) under a shared deterministic nonce stream —
     nonces are public; seed security rests on the per-call master key."""
 
-    def __init__(self, comp, arguments, checks: int):
+    def __init__(self, comp, arguments, checks: int, dialect=None):
         import weakref
 
         # weak: the runner is cached in a weak-keyed dict keyed by the
@@ -400,8 +411,9 @@ class _SelfCheckRunner(_SelfCheckBase):
         # forever (same discipline as _Plan/comp_ref)
         self._comp_ref = weakref.ref(comp)
         self._arguments = arguments
+        self._dialect = dialect
         # whole-graph eager plan: binding metadata + final fallback
-        self.eager_plan = build_plan(comp, arguments, False)
+        self.eager_plan = build_plan(comp, arguments, False, dialect=dialect)
         self._nonce_seed = secrets.randbits(63)
         super().__init__(checks)
 
@@ -411,11 +423,12 @@ class _SelfCheckRunner(_SelfCheckBase):
             raise RuntimeError("computation was garbage-collected")
         limit = self.LADDER[self._level]
         jit_plan = build_plan(
-            comp, self._arguments, True, segment_limit=limit
+            comp, self._arguments, True, segment_limit=limit,
+            dialect=self._dialect,
         )
         ref_plan = build_plan(
             comp, self._arguments, True, segment_limit=limit,
-            jit_segments=False,
+            jit_segments=False, dialect=self._dialect,
         )
         if jit_plan.fn is not None:
             self._jit_fn = jit_plan.fn
@@ -501,7 +514,8 @@ def plan_segments(order, static_env, effective_inputs, limit):
 
 def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
                           limit: Optional[int] = None,
-                          jit_segments: bool = True):
+                          jit_segments: bool = True, dialect=None):
+    dialect = dialect if dialect is not None else logical
     """Split the op order into consecutive segments, jit each as its own
     XLA program, and orchestrate them from the host.  Values crossing a
     boundary travel as jit inputs/outputs (all moose value types are
@@ -528,8 +542,8 @@ def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
             comp = comp_ref()
             if comp is None:  # pragma: no cover - defensive
                 raise RuntimeError("computation was garbage-collected")
-            sess = EagerSession(master_key=master_key, key_domain=si + 1)
-            logical.bind_placements(sess, comp)
+            sess = dialect.make_session(master_key, key_domain=si + 1)
+            dialect.bind_placements(sess, comp)
             # seed with every static value: a static op executed in an
             # earlier segment is not in env_in (statics never cross as
             # jit values) but may feed any later segment
@@ -538,7 +552,8 @@ def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
             outputs: dict[str, Any] = {}
             saves: dict[tuple[str, str], Any] = {}
             _run_ops(
-                sess, comp, names, static_env, env, outputs, saves, dyn
+                sess, comp, names, static_env, env, outputs, saves, dyn,
+                False, dialect,
             )
             return {n: env[n] for n in outs}, outputs, saves
 
@@ -665,9 +680,13 @@ class Interpreter:
     ``id()`` key could be reused by a new computation after the old one is
     garbage-collected and silently serve a stale plan."""
 
-    def __init__(self):
+    def __init__(self, dialect=None):
         import weakref
 
+        # execution layout: None -> per-host logical dialect; an object
+        # with execute_op/to_host/bind_placements/make_session (e.g.
+        # dialects.stacked.StackedDialect) selects another backend
+        self._dialect = dialect
         self._cache = weakref.WeakKeyDictionary()
 
     def evaluate(
@@ -680,7 +699,15 @@ class Interpreter:
         from .. import telemetry
 
         arguments = arguments or {}
-        gated = heavy_jit_gate(len(comp.operations), use_jit)
+        # the gate must see the EXPANDED program size where the dialect
+        # can estimate it (stacked graphs are short at the logical level
+        # but expand protocol nonlinears into thousands of XLA ops)
+        n_ops = (
+            self._dialect.effective_ops(comp)
+            if hasattr(self._dialect, "effective_ops")
+            else len(comp.operations)
+        )
+        gated = heavy_jit_gate(n_ops, use_jit)
         selfcheck = use_jit and not gated and _selfcheck_runs() > 0
         use_jit = gated
         per_comp = self._cache.get(comp)
@@ -692,11 +719,14 @@ class Interpreter:
             with telemetry.span("build_plan", n_ops=len(comp.operations)):
                 if selfcheck:
                     runner = _SelfCheckRunner(
-                        comp, arguments, _selfcheck_runs()
+                        comp, arguments, _selfcheck_runs(),
+                        dialect=self._dialect,
                     )
                     plan, fn = runner.eager_plan, runner.run
                 else:
-                    plan = build_plan(comp, arguments, use_jit)
+                    plan = build_plan(
+                        comp, arguments, use_jit, dialect=self._dialect
+                    )
                     if plan.fn is not None:  # segmented: already jitted
                         fn = plan.fn
                     else:

@@ -101,15 +101,26 @@ class SpmdSession:
     party's devices.
     """
 
-    def __init__(self, master_key):
+    def __init__(self, master_key, domain: int = 0):
         self._master = jnp.asarray(master_key, dtype=jnp.uint32)
         self._counter = 0
+        # distinct domains partition the nonce space so several sessions
+        # sharing one master key (the segmented executor runs one per
+        # graph segment) never reuse a mask; domain 0 reproduces the
+        # historical stream exactly
+        self._domain = int(domain)
 
     def _next_seed(self) -> jax.Array:
         idx = self._counter
         self._counter += 1
         nonce = np.array(
-            [idx & 0xFFFFFFFF, 0x5B3D9E21, idx ^ 0xA5A5A5A5, 7], np.uint32
+            [
+                idx & 0xFFFFFFFF,
+                0x5B3D9E21 ^ ((self._domain * 0x85EBCA6B) & 0xFFFFFFFF),
+                idx ^ 0xA5A5A5A5,
+                7,
+            ],
+            np.uint32,
         )
         return ring.mix_seed(self._master, nonce)
 
@@ -315,6 +326,18 @@ def fill_public(shape, width: int, raw: int) -> SpmdRep:
 # restructured secret).  Logical axis a lives at array axis a + 2.
 
 
+def _laxis(arr, axis: int, extra: int = 0) -> int:
+    """Logical axis -> array axis.  Negative axes count from the end of
+    the LOGICAL shape (a bare +2 would land them on the party/slot
+    axes); ``extra`` admits one-past-the-end for expand_dims/stack."""
+    nd = arr.ndim - 2 + extra
+    if axis < 0:
+        axis += nd
+    if not 0 <= axis < nd:
+        raise ValueError(f"axis {axis} out of range for {nd} logical dims")
+    return axis + 2
+
+
 def _structural(fn):
     def kernel(x: SpmdRep, *args, **kwargs):
         lo = fn(x.lo, *args, **kwargs)
@@ -326,36 +349,40 @@ def _structural(fn):
 
 index_axis = _structural(
     lambda a, axis, idx: jax.lax.index_in_dim(
-        a, idx, axis + 2, keepdims=False
+        a, idx, _laxis(a, axis), keepdims=False
     )
 )
-expand_dims = _structural(lambda a, axis: jnp.expand_dims(a, axis + 2))
+expand_dims = _structural(
+    lambda a, axis: jnp.expand_dims(a, _laxis(a, axis, extra=1))
+)
 reshape = _structural(lambda a, shape: a.reshape(a.shape[:2] + tuple(shape)))
 transpose_2d = _structural(lambda a: jnp.swapaxes(a, -1, -2))
 
 
 def concat(xs, axis: int) -> SpmdRep:
-    lo = jnp.concatenate([x.lo for x in xs], axis=axis + 2)
+    ax = _laxis(xs[0].lo, axis)
+    lo = jnp.concatenate([x.lo for x in xs], axis=ax)
     hi = (
         None
         if xs[0].hi is None
-        else jnp.concatenate([x.hi for x in xs], axis=axis + 2)
+        else jnp.concatenate([x.hi for x in xs], axis=ax)
     )
     return SpmdRep(lo, hi, xs[0].width)
 
 
 def stack(xs, axis: int = 0) -> SpmdRep:
-    lo = jnp.stack([x.lo for x in xs], axis=axis + 2)
+    ax = _laxis(xs[0].lo, axis, extra=1)
+    lo = jnp.stack([x.lo for x in xs], axis=ax)
     hi = (
         None
         if xs[0].hi is None
-        else jnp.stack([x.hi for x in xs], axis=axis + 2)
+        else jnp.stack([x.hi for x in xs], axis=ax)
     )
     return SpmdRep(lo, hi, xs[0].width)
 
 
 def sum_axis(x: SpmdRep, axis: int) -> SpmdRep:
-    lo, hi = ring.sum_(x.lo, x.hi, axis=axis + 2)
+    lo, hi = ring.sum_(x.lo, x.hi, axis=_laxis(x.lo, axis))
     return SpmdRep(lo, hi, x.width)
 
 

@@ -608,7 +608,7 @@ def fx_sqrt(sess, x: SpmdFixed) -> SpmdFixed:
 
 
 def _slice_axis(x: SpmdRep, axis: int, sl: slice) -> SpmdRep:
-    idx = (slice(None),) * (axis + 2) + (sl,)
+    idx = (slice(None),) * spmd._laxis(x.lo, axis) + (sl,)
     lo = x.lo[idx]
     hi = None if x.hi is None else x.hi[idx]
     return SpmdRep(lo, hi, x.width)

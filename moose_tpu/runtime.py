@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from .computation import Computation
+from .dialects import logical as _logical_dialect
 from .edsl import base as edsl_base
 from .edsl import tracer
 from .execution.interpreter import Interpreter
@@ -38,11 +39,36 @@ class LocalMooseRuntime:
         identities: List[str],
         storage_mapping: Optional[Dict[str, Dict]] = None,
         use_jit: Optional[bool] = None,
+        layout: Optional[str] = None,
+        mesh=None,
     ):
         import os
 
         if use_jit is None:
             use_jit = os.environ.get("MOOSE_TPU_JIT", "1") != "0"
+        # execution layout for replicated protocol math:
+        #   "per-host" — six separately-labelled per-party arrays
+        #     (dialects/logical.py), the lowering-compatible default;
+        #   "stacked" — party-stacked SPMD arrays (dialects/stacked.py):
+        #     one (party=3, slot=2, ...) array per sharing, reshares as
+        #     rolls/collective-permutes, shardable over a device mesh
+        #     (pass ``mesh=spmd.make_mesh(...)``).  Graphs with ops the
+        #     stacked dialect does not cover fall back to per-host.
+        if layout is None:
+            layout = os.environ.get("MOOSE_TPU_LAYOUT", "per-host")
+        if layout not in ("per-host", "stacked"):
+            raise ValueError(
+                f"unknown layout {layout!r}; expected 'per-host' or "
+                "'stacked'"
+            )
+        self.layout = layout
+        self._stacked = None
+        if layout == "stacked":
+            from .dialects.stacked import StackedDialect
+
+            self._stacked = Interpreter(
+                dialect=StackedDialect(mesh=mesh)
+            )
         self.use_jit = use_jit
         storage_mapping = storage_mapping or {}
         for identity in storage_mapping:
@@ -114,6 +140,19 @@ class LocalMooseRuntime:
             computation = traced
         computation, arguments = _lift_computation(computation, arguments)
         use_jit = self.use_jit
+        lowered = any(
+            op.kind in self._LOWERED_KINDS
+            for op in computation.operations.values()
+        )
+        if self._stacked is not None and compiler_passes is None:
+            from .dialects import stacked as stacked_dialect
+
+            if not lowered and stacked_dialect.supports(computation):
+                return self._stacked.evaluate(
+                    computation, self.storage, arguments, use_jit=use_jit
+                )
+            # fall through: lowered graphs and unsupported ops keep the
+            # per-host path (documented fallback)
         if compiler_passes is None and use_jit:
             # protocol-heavy replicated graphs expand to tens of
             # thousands of host ops inside ONE logical op (a secure
@@ -174,10 +213,7 @@ class LocalMooseRuntime:
             return self._physical.evaluate(
                 compiled, self.storage, arguments, use_jit=use_jit
             )
-        if any(
-            op.kind in self._LOWERED_KINDS
-            for op in computation.operations.values()
-        ):
+        if lowered:
             # already-lowered host-level graphs (e.g. the reference's
             # *-compiled.moose artifacts parsed from textual) carry ring
             # ops the logical dialect doesn't know; execute them on the
@@ -190,18 +226,11 @@ class LocalMooseRuntime:
         )
 
     # Rough lowered-size weights for replicated-placement math ops
-    # (measured on fixed(24,40)/ring128: a comparison's bit-decompose +
-    # Kogge-Stone adder is ~900 host ops, Goldschmidt division ~4k,
-    # shifted pow2 ~4.5k, softmax ~11k).  Used only to decide WHETHER to
-    # lower — precision beyond the right order of magnitude is wasted.
-    _EXPANSION_WEIGHTS = {
-        "Softmax": 11000, "Sqrt": 13500, "Log": 9500, "Log2": 9500,
-        "Div": 4100, "Inverse": 4100, "Exp": 4600, "Sigmoid": 4600,
-        "Pow2": 4600, "Argmax": 3000, "MaxPool2D": 3000,
-        "Maximum": 2000, "Less": 950, "Greater": 950, "Equal": 1200,
-        "Sign": 950, "Abs": 1000, "Relu": 1000, "Mux": 200,
-        "Dot": 170, "Mul": 130, "Conv2D": 250,
-    }
+    # Rough lowered-size weights (host-op equivalents; see
+    # logical.EXPANSION_WEIGHTS).  Used to decide WHETHER to lower;
+    # shared with the stacked dialect's effective-size estimate for the
+    # TPU heavy-jit gate.
+    _EXPANSION_WEIGHTS = _logical_dialect.EXPANSION_WEIGHTS
 
     def _auto_lower_passes(self, computation):
         """DEFAULT_PASSES when the graph's estimated lowered size exceeds

@@ -1,0 +1,95 @@
+"""Verify crypto/aes_prng.py bit-for-bit against Rust-extracted golden
+vectors (the output of ``scripts/extract_prf_golden.rs`` run on any
+machine with a cargo toolchain — see that file's header).
+
+    python scripts/check_prf_golden.py prf_golden_rust.json
+
+On full agreement this CLOSES the BASELINE "bit-identical outputs"
+claim.  On a mismatch it pins down WHICH consumption rule diverges
+(word order, bit granularity, counter layout) so the fix is mechanical:
+
+- ``next_u64`` mismatch at index 0 → counter/endianness of the CTR
+  keystream itself (crypto/aes_prng.py:_refill).
+- ``next_u64`` ok but ``bits`` mismatch → get_bit granularity: this
+  repo consumes one keystream BYTE per bit draw (aes_prng.get_bit); if
+  the crate consumes a u32 per draw, patch get_bit accordingly.
+- ``ring128_hi_first`` mismatch with next_u64 ok → limb draw order
+  (uniform_u128 swaps high/low).
+- ``derive_seed`` mismatch → blake3 layer (crypto/blake3.py) or the
+  session-id hashing rule (host/prim.rs SessionId::as_bytes).
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from moose_tpu.crypto.aes_prng import AesCtrRng, derive_seed  # noqa: E402
+from moose_tpu.crypto.blake3 import derive_key, keyed_hash  # noqa: E402
+
+
+def main(path: str) -> int:
+    golden = json.load(open(path))
+    seed = bytes.fromhex(golden["seed"])
+    failures = []
+
+    rng = AesCtrRng(seed)
+    got = [rng.next_u64() for _ in range(len(golden["next_u64"]))]
+    want = [int(v) for v in golden["next_u64"]]
+    if got != want:
+        i = next(i for i, (a, b) in enumerate(zip(got, want)) if a != b)
+        failures.append(
+            f"next_u64 diverges at index {i}: got {got[i]}, want {want[i]}"
+            + (" (keystream/counter layout)" if i == 0 else "")
+        )
+
+    rng = AesCtrRng(seed)
+    got = [
+        (rng.next_u64() << 64) + rng.next_u64()
+        for _ in range(len(golden["ring128_hi_first"]))
+    ]
+    want = [int(v) for v in golden["ring128_hi_first"]]
+    if got != want:
+        failures.append("ring128 high-limb-first order diverges")
+
+    rng = AesCtrRng(seed)
+    got = [rng.get_bit() for _ in range(len(golden["bits"]))]
+    if got != list(golden["bits"]):
+        failures.append(
+            "get_bit stream diverges (bit-draw granularity: this repo "
+            "burns one keystream byte per bit)"
+        )
+
+    rng = AesCtrRng(seed)
+    got = rng.next_bytes(len(golden["fill_bytes"]) // 2).hex()
+    if got != golden["fill_bytes"]:
+        failures.append("fill_bytes stream diverges")
+
+    ds = golden["derive_seed"]
+    # raw 16-byte sid (the Rust extractor feeds sid BYTES directly;
+    # derive_seed() in-repo hashes the sid STRING per SessionId::new —
+    # compare at the keyed-hash layer to isolate the blake3 chain)
+    derived = derive_key("Derive Seed", bytes.fromhex(ds["key"]))
+    got = keyed_hash(
+        derived,
+        bytes.fromhex(ds["sid"]) + bytes.fromhex(ds["sync_key"]),
+        out_len=16,
+    ).hex()
+    if got != ds["seed_out"]:
+        failures.append("derive_seed blake3 chain diverges")
+
+    if failures:
+        print("PRF GOLDEN MISMATCH:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print(
+        "PRF golden vectors match bit-for-bit: next_u64, ring128 limb "
+        "order, get_bit, fill_bytes, derive_seed — BASELINE bit-identity "
+        "claim closed."
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -1,0 +1,91 @@
+//! One-command golden-vector extraction for PRF bit-identity
+//! (VERDICT r4 #7: the composed aes-prng stream has no golden vectors
+//! because this build environment has no Rust toolchain).
+//!
+//! Run on ANY machine with cargo:
+//!
+//! ```sh
+//! cargo new prf-golden --bin && cd prf-golden
+//! cat >> Cargo.toml <<'EOF'
+//! aes-prng = "~0.2"
+//! blake3 = "=1.3.0"
+//! rand = "0.8"
+//! EOF
+//! cp /path/to/repo/scripts/extract_prf_golden.rs src/main.rs
+//! cargo run --release > prf_golden_rust.json
+//! # then, back in the repo:
+//! python scripts/check_prf_golden.py prf_golden_rust.json
+//! ```
+//!
+//! It prints one JSON object with the exact streams the reference's
+//! kernels consume (moose/src/host/ops.rs:1959-2040 draw orders;
+//! moose/src/host/prim.rs:113-133 seed derivation):
+//!   - next_u64 stream for a fixed 16-byte seed (AesRng::from_seed)
+//!   - ring128 draws: HIGH limb first, then low (ring128_kernel)
+//!   - get_bit stream (bit_kernel / max_value == 1 sampling)
+//!   - fill_bytes stream (serialization-adjacent consumers)
+//!   - DeriveSeed: blake3::derive_key("Derive Seed", key) then keyed
+//!     hash of session_id_bytes || sync_key_bytes, first 16 bytes
+//!
+//! The repo-side checker (scripts/check_prf_golden.py) compares every
+//! stream against crypto/aes_prng.py and pins down any divergence to
+//! the exact consumption rule (word order / bit granularity), so the
+//! BASELINE "bit-identical outputs" claim is one cargo run from closed.
+
+use aes_prng::AesRng;
+use rand::{RngCore, SeedableRng};
+
+fn hex(bytes: &[u8]) -> String {
+    bytes.iter().map(|b| format!("{:02x}", b)).collect()
+}
+
+fn main() {
+    let seed: [u8; 16] = *b"moose-prf-golden";
+
+    // 1) raw next_u64 stream
+    let mut rng = AesRng::from_seed(seed);
+    let u64s: Vec<String> = (0..32).map(|_| format!("{}", rng.next_u64())).collect();
+
+    // 2) ring128 element draws: high limb first (host/ops.rs:2001)
+    let mut rng = AesRng::from_seed(seed);
+    let ring128: Vec<String> = (0..16)
+        .map(|_| {
+            let v = ((rng.next_u64() as u128) << 64) + rng.next_u64() as u128;
+            format!("{}", v)
+        })
+        .collect();
+
+    // 3) bit draws (host/ops.rs bit_kernel / get_bit)
+    let mut rng = AesRng::from_seed(seed);
+    let bits: Vec<u8> = (0..256).map(|_| rng.get_bit()).collect();
+
+    // 4) fill_bytes stream
+    let mut rng = AesRng::from_seed(seed);
+    let mut buf = [0u8; 64];
+    rng.fill_bytes(&mut buf);
+
+    // 5) DeriveSeed (host/prim.rs:113-133): nonce = sid || sync_key
+    let key_bytes: [u8; 16] = *b"moose-prfkey-16b";
+    let sid_bytes: [u8; 16] = *b"session-id-16byt";
+    let sync_key_bytes: [u8; 16] = *b"sync-key-16bytes";
+    let derived_key = blake3::derive_key("Derive Seed", &key_bytes);
+    let mut hasher = blake3::Hasher::new_keyed(&derived_key);
+    hasher.update(&sid_bytes);
+    hasher.update(&sync_key_bytes);
+    let mut okr = hasher.finalize_xof();
+    let mut seed_out = [0u8; 16];
+    okr.fill(&mut seed_out);
+
+    println!(
+        "{{\n  \"seed\": \"{}\",\n  \"next_u64\": [{}],\n  \"ring128_hi_first\": [{}],\n  \"bits\": {:?},\n  \"fill_bytes\": \"{}\",\n  \"derive_seed\": {{\"key\": \"{}\", \"sid\": \"{}\", \"sync_key\": \"{}\", \"seed_out\": \"{}\"}}\n}}",
+        hex(&seed),
+        u64s.iter().map(|s| format!("\"{}\"", s)).collect::<Vec<_>>().join(", "),
+        ring128.iter().map(|s| format!("\"{}\"", s)).collect::<Vec<_>>().join(", "),
+        bits,
+        hex(&buf),
+        hex(&key_bytes),
+        hex(&sid_bytes),
+        hex(&sync_key_bytes),
+        hex(&seed_out),
+    );
+}

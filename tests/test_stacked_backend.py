@@ -1,0 +1,283 @@
+"""User graphs on the party-stacked SPMD backend (VERDICT r4 #1).
+
+The SAME traced/``from_onnx`` computations that run on the per-host
+logical dialect execute on ``LocalMooseRuntime(layout="stacked")``
+through ``dialects/stacked.py``, which maps replicated ops onto the
+``parallel/spmd*`` kernels.  Cross-layout equivalence discipline follows
+``tests/test_spmd.py``: exact ring ops (share/add/reveal) must agree
+bit-for-bit; protocols with probabilistic truncation agree within the
+2^-f trunc tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.parallel import spmd
+from moose_tpu.runtime import LocalMooseRuntime
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def _logreg_comp(fx_dtype):
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with bob:
+            w_f = pm.cast(w, dtype=fx_dtype)
+        with rep:
+            y = pm.sigmoid(pm.dot(x_f, w_f))
+        with carole:
+            y_host = pm.cast(y, dtype=pm.float64)
+        return y_host
+
+    return comp
+
+
+@pytest.mark.parametrize("fx_dtype", [pm.fixed(8, 27), pm.fixed(14, 23)],
+                         ids=["fixed64", "fixed128"])
+def test_traced_logreg_stacked_matches_per_host(fx_dtype):
+    comp = _logreg_comp(fx_dtype)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)) * 0.5
+    w = rng.normal(size=(4, 1)) * 0.5
+    args = {"x": x, "w": w}
+    want = 1.0 / (1.0 + np.exp(-(x @ w)))
+
+    rt_h = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got_h,) = rt_h.evaluate_computation(comp, arguments=args).values()
+    rt_s = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    assert rt_s.layout == "stacked"
+    (got_s,) = rt_s.evaluate_computation(comp, arguments=args).values()
+
+    np.testing.assert_allclose(np.asarray(got_s), want, atol=1e-3)
+    # both backends approximate the same protocol; difference is bounded
+    # by the probabilistic-truncation tolerance
+    np.testing.assert_allclose(
+        np.asarray(got_s), np.asarray(got_h), atol=1e-4
+    )
+
+
+def test_linear_graph_bit_identical_across_layouts():
+    """Share/add/sub/reveal has no truncation and no randomness in the
+    revealed value: the two layouts must agree bit-for-bit."""
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(14, 23)
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        y: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with bob:
+            y_f = pm.cast(y, dtype=fx_dtype)
+        with rep:
+            z = pm.add(x_f, pm.sub(x_f, y_f))
+        with carole:
+            out = pm.cast(z, dtype=pm.float64)
+        return out
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 3))
+    y = rng.normal(size=(8, 3))
+    args = {"x": x, "y": y}
+    rt_h = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got_h,) = rt_h.evaluate_computation(comp, arguments=args).values()
+    rt_s = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    (got_s,) = rt_s.evaluate_computation(comp, arguments=args).values()
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(got_s))
+
+
+def test_negative_axis_matches_per_host():
+    """axis=-1 must hit the last LOGICAL axis, not the share-slot axis
+    (code-review r5 finding: a bare +2 offset mapped negative axes onto
+    the pair layout, silently corrupting results)."""
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(14, 23)
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with rep:
+            s = pm.sum(x_f, axis=-1)
+        with carole:
+            return pm.cast(s, dtype=pm.float64)
+
+    x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 7.0]])
+    rt_h = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got_h,) = rt_h.evaluate_computation(comp, arguments={"x": x}).values()
+    rt_s = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    (got_s,) = rt_s.evaluate_computation(comp, arguments={"x": x}).values()
+    np.testing.assert_allclose(np.asarray(got_h), x.sum(axis=-1), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(got_s))
+
+
+def test_stacked_aes_decrypt_via_runtime():
+    """Encrypted-input inference reaches the stacked AES path through
+    the runtime (supports() must admit rep-placed Input ops)."""
+    from moose_tpu.dialects import aes
+    from moose_tpu.dialects import stacked as stacked_dialect
+    from moose_tpu.edsl import tracer
+
+    alice, bob, carole, rep = _players()
+    FIXED = pm.fixed(14, 23)
+
+    @pm.computation
+    def secure_score(
+        aes_data: pm.Argument(placement=alice,
+                              vtype=pm.AesTensorType(dtype=FIXED)),
+        aes_key: pm.Argument(placement=rep, vtype=pm.AesKeyType()),
+    ):
+        with rep:
+            x = pm.decrypt(aes_key, aes_data)
+        with carole:
+            return pm.cast(x, dtype=pm.float64)
+
+    traced = tracer.trace(secure_score)
+    assert stacked_dialect.supports(traced)
+
+    rng = np.random.default_rng(3)
+    values = rng.normal(size=(2, 2))
+    key = bytes(range(16))
+    nonce = bytes([9] * 12)
+    wire = aes.encrypt_fixed_array(key, nonce, values, frac_precision=23)
+    rt = LocalMooseRuntime(
+        ["alice", "bob", "carole"], layout="stacked", use_jit=True
+    )
+    (out,) = rt.evaluate_computation(
+        secure_score,
+        arguments={
+            "aes_data": np.asarray(wire),
+            "aes_key": np.asarray(aes.bytes_to_bits_be(key)),
+        },
+    ).values()
+    np.testing.assert_allclose(np.asarray(out), values, atol=2e-6)
+
+
+def test_traced_softmax_argmax_stacked():
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with rep:
+            s = pm.softmax(x_f, axis=1, upmost_index=4)
+            a = pm.argmax(x_f, axis=1, upmost_index=4)
+        with carole:
+            s_out = pm.cast(s, dtype=pm.float64)
+            a_out = pm.cast(a, dtype=pm.uint64)
+        return s_out, a_out
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(6, 4)) * 2.0
+    want_s = np.exp(x - x.max(1, keepdims=True))
+    want_s /= want_s.sum(1, keepdims=True)
+
+    rt = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    out = rt.evaluate_computation(comp, arguments={"x": x})
+    vals = list(out.values())
+    s, a = np.asarray(vals[0]), np.asarray(vals[1])
+    np.testing.assert_allclose(s, want_s, atol=5e-2)
+    np.testing.assert_array_equal(a, x.argmax(1))
+
+
+def test_onnx_logreg_stacked_matches_sklearn_and_per_host():
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn import linear_model
+
+    import onnx_fixtures as fx
+    from moose_tpu import predictors
+
+    rng = np.random.default_rng(1234)
+    x = rng.normal(size=(60, 4))
+    y = rng.integers(0, 2, size=60)
+    x += 0.8 * np.eye(4)[y % 4]
+    sk = linear_model.LogisticRegression(max_iter=300).fit(x, y)
+    onnx_model = fx.logistic_regression_onnx(sk, x.shape[1])
+    model = predictors.from_onnx(onnx_model)
+    comp = model.predictor_factory()
+    args = {"x": np.asarray(x[:8], dtype=np.float64)}
+
+    rt_s = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    (got_s,) = rt_s.evaluate_computation(comp, arguments=args).values()
+    np.testing.assert_allclose(
+        np.asarray(got_s), sk.predict_proba(x[:8]), atol=5e-3
+    )
+    rt_h = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got_h,) = rt_h.evaluate_computation(comp, arguments=args).values()
+    np.testing.assert_allclose(
+        np.asarray(got_s), np.asarray(got_h), atol=1e-4
+    )
+
+
+def test_stacked_on_party_mesh():
+    """The stacked backend shards over a real (parties=3, data) mesh: the
+    conftest's 12 virtual CPU devices give a (3, 4) mesh, and the user
+    graph still produces correct results under the sharding constraint."""
+    import jax
+
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >= 3 devices")
+    mesh = spmd.make_mesh(min(12, len(jax.devices())))
+    comp = _logreg_comp(pm.fixed(14, 23))
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(16, 4)) * 0.5
+    w = rng.normal(size=(4, 1)) * 0.5
+    want = 1.0 / (1.0 + np.exp(-(x @ w)))
+    rt = LocalMooseRuntime(
+        ["alice", "bob", "carole"], layout="stacked", mesh=mesh
+    )
+    (got,) = rt.evaluate_computation(
+        comp, arguments={"x": x, "w": w}
+    ).values()
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-3)
+
+
+def test_unsupported_graph_falls_back_to_per_host():
+    """Graphs with replicated ops outside the stacked dialect's coverage
+    still run (per-host fallback), so layout='stacked' is always safe."""
+    from moose_tpu.dialects import stacked as stacked_dialect
+    from moose_tpu.edsl import tracer
+
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(8, 27)
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+            mask = pm.constant(
+                np.array([True, False, True]), dtype=pm.bool_
+            )
+        with rep:
+            y = pm.mul(x_f, x_f)
+        with carole:
+            y_h = pm.cast(y, dtype=pm.float64)
+            out = pm.select(y_h, 0, mask)
+        return out
+
+    traced = tracer.trace(comp)
+    assert not stacked_dialect.supports(traced)  # Select is dynamic-shape
+    x = np.array([1.0, 2.0, 3.0])
+    rt = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    (got,) = rt.evaluate_computation(comp, arguments={"x": x}).values()
+    np.testing.assert_allclose(
+        np.asarray(got), [1.0, 9.0], atol=1e-3
+    )  # executed via the per-host fallback
