@@ -107,7 +107,7 @@ def tpu_numerics_check():
     return True
 
 
-def _bench_predictor(comp, args, check, batch, layout=None):
+def _bench_predictor(comp, args, check, batch, layout=None, iters=5):
     """Median steady-state latency/throughput of one predictor comp.
 
     Opts in to TPU jit for heavy protocol graphs despite the documented
@@ -170,7 +170,7 @@ def _bench_predictor(comp, args, check, batch, layout=None):
     out = payload
     check(out)
     times = []
-    for _ in range(5):
+    for _ in range(iters):
         t0 = time.perf_counter()
         runtime.evaluate_computation(comp, arguments=args)
         times.append(time.perf_counter() - t0)
@@ -178,7 +178,7 @@ def _bench_predictor(comp, args, check, batch, layout=None):
     return batch / latency, latency
 
 
-def bench_logreg_inference(batch=128, features=100, layout=None):
+def bench_logreg_inference(batch=128, features=100, layout=None, iters=5):
     """North-star metric: encrypted inferences/sec through the ONNX
     predictor path (BASELINE.md north-star section).  ``layout="stacked"``
     measures the SAME user path on the party-stacked SPMD backend
@@ -202,7 +202,9 @@ def bench_logreg_inference(batch=128, features=100, layout=None):
         err = np.abs(out - sk.predict_proba(x)).max()
         assert err < 5e-3, f"logreg mismatch: {err}"
 
-    return _bench_predictor(comp, {"x": x}, check, batch, layout=layout)
+    return _bench_predictor(
+        comp, {"x": x}, check, batch, layout=layout, iters=iters
+    )
 
 
 def bench_logreg_handwritten(batch=128, features=100):
@@ -461,20 +463,6 @@ def main():
         print(f"# logreg inference bench failed: {e}")
     emit()
 
-    # user-path stacked backend vs hand-written stacked kernels
-    # (VERDICT r4 #1 done-criterion: the compiled user path must land
-    # within shouting distance of the hand-written spmd number)
-    try:
-        if _within_budget():
-            per_sec_s, lat_s = bench_logreg_inference(layout="stacked")
-            record["logreg_infer_per_sec_stacked_userpath"] = per_sec_s
-            record["logreg_stacked_userpath_latency_s"] = lat_s
-            per_sec_h, lat_h = bench_logreg_handwritten()
-            record["logreg_infer_per_sec_handwritten"] = per_sec_h
-            emit()
-    except Exception as e:
-        print(f"# stacked user-path bench failed: {e}")
-
     # BASELINE.json configs: batch-1024 encrypted inference
     try:
         if _within_budget():
@@ -491,6 +479,26 @@ def main():
     except Exception as e:
         print(f"# mlp batch-1024 bench failed: {e}")
     emit()
+
+    # user-path stacked backend vs hand-written stacked kernels
+    # (VERDICT r4 #1 done-criterion).  LAST stage by design: on the
+    # experimental TPU backend the predictor's fixed(24,40) protocol
+    # sigmoid trips the known fusion miscompile, the self-check demotes
+    # the plan to eager, and each call costs tens of seconds through
+    # the tunnel — honest, correct, and not allowed to starve the
+    # established metrics above.
+    try:
+        if _within_budget():
+            per_sec_s, lat_s = bench_logreg_inference(
+                layout="stacked", iters=3
+            )
+            record["logreg_infer_per_sec_stacked_userpath"] = per_sec_s
+            record["logreg_stacked_userpath_latency_s"] = lat_s
+            per_sec_h, lat_h = bench_logreg_handwritten()
+            record["logreg_infer_per_sec_handwritten"] = per_sec_h
+            emit()
+    except Exception as e:
+        print(f"# stacked user-path bench failed: {e}")
 
 
 if __name__ == "__main__":

@@ -286,6 +286,11 @@ EXPANSION_WEIGHTS = {
     "Maximum": 2000, "Less": 950, "Greater": 950, "Equal": 1200,
     "Sign": 950, "Abs": 1000, "Relu": 1000, "Mux": 200,
     "Dot": 170, "Mul": 130, "Conv2D": 250,
+    # AES-GCM decrypt circuit (~80 AND levels + b2a compose); never
+    # reaches auto-lowering (AES graphs stay logical by choice) but the
+    # stacked dialect's TPU heavy-jit gate must see it as heavy so the
+    # jitted circuit is self-check-validated before being trusted
+    "Decrypt": 200000,
 }
 
 

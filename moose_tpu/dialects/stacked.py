@@ -85,10 +85,6 @@ def bind_placements(sess: StackedSession, comp: Computation):
     logical.bind_placements(sess.host, comp)
 
 
-def make_session(master_key, key_domain: int = 0) -> StackedSession:
-    return StackedSession(master_key, key_domain=key_domain)
-
-
 class StackedDialect:
     """Module-shaped dialect handle carrying backend config (mesh); the
     interpreter only needs ``execute_op`` / ``to_host`` /
@@ -416,11 +412,17 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
 
     if kind == "Softmax":
         x = to_rep(sess, args[0])
-        return sm.fx_softmax(sess.spmd, x, op.attributes["axis"])
+        return sm.fx_softmax(
+            sess.spmd, x, op.attributes["axis"],
+            upmost_index=op.attributes.get("upmost_index"),
+        )
 
     if kind == "Argmax":
         x = to_rep(sess, args[0])
-        return sm.fx_argmax(sess.spmd, x, op.attributes["axis"])
+        return sm.fx_argmax(
+            sess.spmd, x, op.attributes["axis"],
+            upmost_index=op.attributes.get("upmost_index"),
+        )
 
     if kind == "Maximum":
         vals = [to_rep(sess, a) for a in args]
@@ -511,7 +513,7 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
 
 # replicated-placement kinds the stacked backend executes; used by
 # supports() so the runtime can fall back to the per-host path for
-# anything else (e.g. Decrypt)
+# anything else (e.g. a future op kind before its stacked kernel lands)
 _REP_KINDS = frozenset({
     "Identity", "Constant", "Add", "Sub", "Mul", "Dot", "Div", "AddN",
     "Neg", "Less", "Greater", "Equal", "And", "Or", "Xor", "Mux", "Sum",

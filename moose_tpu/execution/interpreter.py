@@ -396,14 +396,19 @@ class _SelfCheckBase:
 
 
 class _SelfCheckRunner(_SelfCheckBase):
-    """Self-check over LOGICAL computations (this module's plans).
+    """THE validated-jit runner, shared by the logical and physical
+    executors (VERDICT r4 #6: one self-check engine, not two).
 
-    The logical kernels draw trace-time sync-key nonces, so the eager
-    reference replays the candidate's exact structure (same segments,
-    key domains, op walk) under a shared deterministic nonce stream —
-    nonces are public; seed security rests on the per-call master key."""
+    Parameterized by a ``builder(comp, arguments, use_jit, segment_limit,
+    jit_segments) -> (plan_obj, executable)`` and by nonce pinning: the
+    logical dialect's kernels draw trace-time sync-key nonces, so its
+    eager reference replays the candidate under a shared deterministic
+    nonce stream (nonces are public; seed security rests on the per-call
+    master key); physical plans take every PRF key as a runtime input
+    with sync keys baked as attributes, so no pinning is needed."""
 
-    def __init__(self, comp, arguments, checks: int, dialect=None):
+    def __init__(self, comp, arguments, checks: int, dialect=None,
+                 builder=None, pin_nonces: bool = True):
         import weakref
 
         # weak: the runner is cached in a weak-keyed dict keyed by the
@@ -411,9 +416,16 @@ class _SelfCheckRunner(_SelfCheckBase):
         # forever (same discipline as _Plan/comp_ref)
         self._comp_ref = weakref.ref(comp)
         self._arguments = arguments
-        self._dialect = dialect
+        self._builder = (
+            builder
+            if builder is not None
+            else _logical_plan_builder(dialect)
+        )
+        self._pin_nonces = pin_nonces
         # whole-graph eager plan: binding metadata + final fallback
-        self.eager_plan = build_plan(comp, arguments, False, dialect=dialect)
+        self.eager_plan, self._eager_exec = self._builder(
+            comp, arguments, False, None, True
+        )
         self._nonce_seed = secrets.randbits(63)
         super().__init__(checks)
 
@@ -422,23 +434,15 @@ class _SelfCheckRunner(_SelfCheckBase):
         if comp is None:  # pragma: no cover - defensive
             raise RuntimeError("computation was garbage-collected")
         limit = self.LADDER[self._level]
-        jit_plan = build_plan(
-            comp, self._arguments, True, segment_limit=limit,
-            dialect=self._dialect,
+        _, self._jit_fn = self._builder(
+            comp, self._arguments, True, limit, True
         )
-        ref_plan = build_plan(
-            comp, self._arguments, True, segment_limit=limit,
-            jit_segments=False, dialect=self._dialect,
+        _, self._ref_fn = self._builder(
+            comp, self._arguments, True, limit, False
         )
-        if jit_plan.fn is not None:
-            self._jit_fn = jit_plan.fn
-            self._ref_fn = ref_plan.fn
-        else:  # graph below the segment limit: whole-graph pair
-            self._jit_fn = jax.jit(jit_plan.core)
-            self._ref_fn = ref_plan.core
 
     def _eager_fn(self, *args):
-        return self.eager_plan.core(*args)
+        return self._eager_exec(*args)
 
     def _on_promoted(self):
         super()._on_promoted()
@@ -447,6 +451,8 @@ class _SelfCheckRunner(_SelfCheckBase):
         self._arguments = None
 
     def _invoke(self, fn, *args):
+        if not self._pin_nonces:
+            return fn(*args)
         from ..dialects import host
 
         with host.deterministic_sync_keys(self._nonce_seed):
@@ -454,6 +460,23 @@ class _SelfCheckRunner(_SelfCheckBase):
 
     def _with_nonces(self, fn, *args):  # kept for tests/direct callers
         return self._invoke(fn, *args)
+
+
+def _logical_plan_builder(dialect):
+    """builder hook for :class:`_SelfCheckRunner` over logical plans."""
+
+    def build(comp, arguments, use_jit, segment_limit, jit_segments):
+        plan = build_plan(
+            comp, arguments, use_jit, segment_limit=segment_limit,
+            jit_segments=jit_segments, dialect=dialect,
+        )
+        if plan.fn is not None:  # segmented: already assembled
+            return plan, plan.fn
+        if use_jit and jit_segments:
+            return plan, jax.jit(plan.core)
+        return plan, plan.core
+
+    return build
 
 
 def _segment_limit() -> int:
@@ -512,25 +535,33 @@ def plan_segments(order, static_env, effective_inputs, limit):
     return chunks, in_names, out_names
 
 
-def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
-                          limit: Optional[int] = None,
-                          jit_segments: bool = True, dialect=None):
-    dialect = dialect if dialect is not None else logical
-    """Split the op order into consecutive segments, jit each as its own
-    XLA program, and orchestrate them from the host.  Values crossing a
-    boundary travel as jit inputs/outputs (all moose value types are
-    registered pytrees).  Each segment runs its own EagerSession over the
-    same master key with a distinct key domain, so PRF streams never
-    collide across segments.
+def build_segmented_runner(order, static_env, dynamic_names,
+                           effective_inputs, limit, jit_segments,
+                           seg_exec, rand_slice, segmentation=None):
+    """THE segment orchestrator, shared by the logical and physical
+    executors (VERDICT r4 #6: one segment planner, not two): split the
+    op order into consecutive segments, jit each as its own XLA program,
+    and orchestrate them from the host.  Values crossing a boundary
+    travel as jit inputs/outputs (all moose value types are registered
+    pytrees).
 
-    ``jit_segments=False`` keeps the identical structure (segments, key
-    domains, op walk) but dispatches each segment eagerly — the exact
-    reference the jit self-check compares against."""
-    comp = comp_ref()
-    chunks, in_names, out_names = plan_segments(
-        order, static_env,
-        lambda n: comp.operations[n].inputs,
-        limit if limit is not None else _segment_limit(),
+    ``seg_exec(si, names, rand, dyn, env, outputs, saves)`` runs one
+    segment's ops against ``env`` (the executor supplies its session
+    discipline there); ``rand_slice(rand, si)`` narrows the per-call
+    randomness (whole master key for logical plans, the segment's PRF
+    key dict for physical ones).  ``jit_segments=False`` keeps the
+    identical structure but dispatches each segment eagerly — the exact
+    reference the jit self-check compares against.  ``segmentation``
+    accepts a precomputed ``plan_segments`` result so callers that also
+    need the chunking (per-segment key narrowing) don't run the
+    boundary-dataflow analysis twice."""
+    chunks, in_names, out_names = (
+        segmentation
+        if segmentation is not None
+        else plan_segments(
+            order, static_env, effective_inputs,
+            limit if limit is not None else _segment_limit(),
+        )
     )
     dyn_set = set(dynamic_names)
     dyn_of = [[n for n in names if n in dyn_set] for names in chunks]
@@ -538,12 +569,7 @@ def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
     def make_seg(si, names):
         outs = out_names[si]
 
-        def seg(master_key, dyn, env_in):
-            comp = comp_ref()
-            if comp is None:  # pragma: no cover - defensive
-                raise RuntimeError("computation was garbage-collected")
-            sess = dialect.make_session(master_key, key_domain=si + 1)
-            dialect.bind_placements(sess, comp)
+        def seg(rand, dyn, env_in):
             # seed with every static value: a static op executed in an
             # earlier segment is not in env_in (statics never cross as
             # jit values) but may feed any later segment
@@ -551,29 +577,57 @@ def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
             env.update(env_in)
             outputs: dict[str, Any] = {}
             saves: dict[tuple[str, str], Any] = {}
-            _run_ops(
-                sess, comp, names, static_env, env, outputs, saves, dyn,
-                False, dialect,
-            )
+            seg_exec(si, names, rand, dyn, env, outputs, saves)
             return {n: env[n] for n in outs}, outputs, saves
 
         return jax.jit(seg) if jit_segments else seg
 
     seg_fns = [make_seg(si, names) for si, names in enumerate(chunks)]
 
-    def run(master_key, dyn: dict):
+    def run(rand, dyn: dict):
         env: dict[str, Any] = {}
         outputs: dict[str, Any] = {}
         saves: dict[tuple[str, str], Any] = {}
         for si, fn in enumerate(seg_fns):
-            dyn_i = {n: dyn[n] for n in dyn_of[si]}
-            env_in = {n: env[n] for n in in_names[si]}
-            env_out, out_i, sv_i = fn(master_key, dyn_i, env_in)
+            env_out, out_i, sv_i = fn(
+                rand_slice(rand, si),
+                {n: dyn[n] for n in dyn_of[si]},
+                {n: env[n] for n in in_names[si]},
+            )
             env.update(env_out)
             outputs.update(out_i)
             saves.update(sv_i)
         return outputs, saves
 
+    return run
+
+
+def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
+                          limit: Optional[int] = None,
+                          jit_segments: bool = True, dialect=None):
+    """Logical-plan segmentation: each segment runs its own session over
+    the same master key with a distinct key domain, so PRF streams never
+    collide across segments."""
+    dialect = dialect if dialect is not None else logical
+    comp = comp_ref()
+
+    def seg_exec(si, names, master_key, dyn, env, outputs, saves):
+        comp = comp_ref()
+        if comp is None:  # pragma: no cover - defensive
+            raise RuntimeError("computation was garbage-collected")
+        sess = dialect.make_session(master_key, key_domain=si + 1)
+        dialect.bind_placements(sess, comp)
+        _run_ops(
+            sess, comp, names, static_env, env, outputs, saves, dyn,
+            False, dialect,
+        )
+
+    run = build_segmented_runner(
+        order, static_env, dynamic_names,
+        lambda n: comp.operations[n].inputs,
+        limit, jit_segments, seg_exec,
+        lambda master_key, si: master_key,
+    )
     return _Plan(order, static_env, dynamic_names, True, run, fn=run)
 
 

@@ -421,17 +421,11 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
 def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
                               key_ops, recv_src, limit=None,
                               jit_segments: bool = True):
-    """Segment a lowered graph into separately-jitted XLA programs (see
-    interpreter._build_segmented_plan for the rationale).  Receive ops
-    read their Send's input through ``recv_src``, so cross-segment
-    transfers are ordinary boundary values.  ``jit_segments=False``
-    keeps the structure but dispatches each segment eagerly — the exact
-    reference the jit self-check compares against (the lowered graph is
-    fully deterministic given the ``keys`` input: sync keys are baked
-    attributes, so no nonce pinning is needed here)."""
-    import jax
-
-    from .interpreter import _segment_limit, plan_segments
+    """Lowered-graph segmentation over the SHARED orchestrator
+    (interpreter.build_segmented_runner).  Receive ops read their Send's
+    input through ``recv_src``, so cross-segment transfers are ordinary
+    boundary values; each segment receives only its own PRF keys."""
+    from .interpreter import build_segmented_runner
 
     comp = comp_ref()
 
@@ -441,105 +435,49 @@ def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
             return [recv_src[op.name]]
         return op.inputs
 
-    chunks, in_names, out_names = plan_segments(
+    key_set = set(key_ops)
+
+    def seg_exec(si, names, keys, dyn, env, outputs, saves):
+        comp = comp_ref()
+        if comp is None:  # pragma: no cover - defensive
+            raise KernelError("computation was garbage-collected")
+        sess = EagerSession()
+        _run_physical_ops(
+            sess, comp, names, static_env, env, outputs, saves,
+            keys, dyn, recv_src,
+        )
+
+    # per-segment key narrowing needs the chunking; compute it once and
+    # hand the same result to the orchestrator
+    from .interpreter import _segment_limit, plan_segments
+
+    segmentation = plan_segments(
         order, static_env, effective_inputs,
         limit if limit is not None else _segment_limit(),
     )
-    dyn_set = set(dyn_names)
-    key_set = set(key_ops)
-    dyn_of = [[n for n in names if n in dyn_set] for names in chunks]
-    keys_of = [[n for n in names if n in key_set] for names in chunks]
+    keys_of = [
+        [n for n in names if n in key_set] for names in segmentation[0]
+    ]
 
-    def make_seg(si, names):
-        outs = out_names[si]
-
-        def seg(keys, dyn, env_in):
-            comp = comp_ref()
-            if comp is None:  # pragma: no cover - defensive
-                raise KernelError("computation was garbage-collected")
-            sess = EagerSession()
-            env: dict[str, Any] = dict(static_env)
-            env.update(env_in)
-            outputs: dict[str, Any] = {}
-            saves: dict[tuple, Any] = {}
-            _run_physical_ops(
-                sess, comp, names, static_env, env, outputs, saves,
-                keys, dyn, recv_src,
-            )
-            return {n: env[n] for n in outs}, outputs, saves
-
-        return jax.jit(seg) if jit_segments else seg
-
-    seg_fns = [make_seg(si, names) for si, names in enumerate(chunks)]
-
-    def run(keys: dict, dyn: dict):
-        env: dict[str, Any] = {}
-        outputs: dict[str, Any] = {}
-        saves: dict[tuple, Any] = {}
-        for si, fn in enumerate(seg_fns):
-            env_out, out_i, sv_i = fn(
-                {n: keys[n] for n in keys_of[si]},
-                {n: dyn[n] for n in dyn_of[si]},
-                {n: env[n] for n in in_names[si]},
-            )
-            env.update(env_out)
-            outputs.update(out_i)
-            saves.update(sv_i)
-        return outputs, saves
-
-    return run
+    return build_segmented_runner(
+        order, static_env, dyn_names, effective_inputs, limit,
+        jit_segments, seg_exec,
+        lambda keys, si: {n: keys[n] for n in keys_of[si]},
+        segmentation=segmentation,
+    )
 
 
-class _PhysicalSelfCheckRunner:
-    """Self-check over LOWERED computations: the physical plan takes all
-    PRF keys as runtime inputs and every sync key is a baked graph
-    attribute, so eager and jitted execution of the same plan from the
-    same ``keys`` dict must be bit-identical with no nonce pinning.
-    State machine shared with the logical runner (interpreter
-    _SelfCheckBase)."""
-
-    def __init__(self, comp, arguments, checks: int):
-        import weakref
-
-        from .interpreter import _SelfCheckBase
-
-        self._comp_ref = weakref.ref(comp)
-        self._arguments = arguments
-        self.eager_plan = _build_plan(comp, arguments, False)
-
-        outer = self
-
-        class _Impl(_SelfCheckBase):
-            def _build_candidate(self):
-                comp = outer._comp_ref()
-                if comp is None:  # pragma: no cover - defensive
-                    raise KernelError("computation was garbage-collected")
-                limit = self.LADDER[self._level]
-                jit_plan = _build_plan(
-                    comp, outer._arguments, True, segment_limit=limit
-                )
-                ref_plan = _build_plan(
-                    comp, outer._arguments, True, segment_limit=limit,
-                    jit_segments=False,
-                )
-                self._jit_fn = jit_plan[4]
-                self._ref_fn = ref_plan[4]
-
-            def _eager_fn(self, *args):
-                return outer.eager_plan[4](*args)
-
-            def _on_promoted(self):
-                super()._on_promoted()
-                outer._arguments = None
-
-        self._impl = _Impl(checks)
-
-    @property
-    def mode(self):
-        return self._impl.mode
-
-    def run(self, keys, dyn):
-        return self._impl.run(keys, dyn)
+def _physical_plan_builder(comp, arguments, use_jit, segment_limit,
+                           jit_segments):
+    """builder hook for the shared ``_SelfCheckRunner``: physical plans
+    take every PRF key as a runtime input and bake sync keys as graph
+    attributes, so eager and jitted execution from the same ``keys``
+    dict must be bit-identical (no nonce pinning)."""
+    plan = _build_plan(
+        comp, arguments, use_jit, segment_limit=segment_limit,
+        jit_segments=jit_segments,
+    )
+    return plan, plan[4]
 
 
 class PhysicalInterpreter:
@@ -573,8 +511,11 @@ class PhysicalInterpreter:
         plan = per_comp.get(cache_key)
         if plan is None:
             if selfcheck:
-                runner = _PhysicalSelfCheckRunner(
-                    comp, arguments, _selfcheck_runs()
+                from .interpreter import _SelfCheckRunner
+
+                runner = _SelfCheckRunner(
+                    comp, arguments, _selfcheck_runs(),
+                    builder=_physical_plan_builder, pin_nonces=False,
                 )
                 order, key_ops, dyn_names, static_env, _ = runner.eager_plan
                 plan = (order, key_ops, dyn_names, static_env, runner.run)

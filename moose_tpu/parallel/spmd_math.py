@@ -686,18 +686,31 @@ def argmax_axis(sess, x: SpmdRep, axis: int) -> SpmdRep:
     return spmd.index_axis(idx, axis, 0)
 
 
-def fx_argmax(sess, x: SpmdFixed, axis: int) -> SpmdRep:
-    return argmax_axis(sess, x.tensor, axis)
+def fx_argmax(sess, x: SpmdFixed, axis: int,
+              upmost_index: int = None) -> SpmdRep:
+    """Argmax over the first ``upmost_index`` entries of ``axis`` (the
+    reference's tournament window, argmax.rs:6-47); whole axis when
+    None/full — slicing preserves index correspondence."""
+    t = x.tensor
+    if upmost_index is not None and upmost_index < t.shape[axis]:
+        t = _slice_axis(t, axis, slice(0, upmost_index))
+    return argmax_axis(sess, t, axis)
 
 
-def fx_softmax(sess, x: SpmdFixed, axis: int) -> SpmdFixed:
+def fx_softmax(sess, x: SpmdFixed, axis: int,
+               upmost_index: int = None) -> SpmdFixed:
     """Numerically-safe softmax (softmax.rs:56-130): subtract max, clamp
     at the exp-underflow threshold, exp (positive-only path), zero the
-    clamped lanes, normalize by one Goldschmidt division."""
+    clamped lanes, normalize by one Goldschmidt division.
+    ``upmost_index`` bounds the max window exactly like the per-host
+    dialect (fixedpoint.softmax)."""
     i_p, f_p = x.integral_precision, x.fractional_precision
     width = x.tensor.width
 
-    xmax = max_axis(sess, x.tensor, axis)
+    xmax_src = x.tensor
+    if upmost_index is not None and upmost_index < xmax_src.shape[axis]:
+        xmax_src = _slice_axis(xmax_src, axis, slice(0, upmost_index))
+    xmax = max_axis(sess, xmax_src, axis)
     xmax_e = spmd.expand_dims(xmax, axis)
     diff = SpmdFixed(spmd.sub(x.tensor, xmax_e), i_p, f_p)
 

@@ -219,7 +219,10 @@ def test_physical_selfcheck_promotes_and_is_exact():
     from moose_tpu.execution import physical
 
     comp, args, want = _lowered_dot_setup()
-    runner = physical._PhysicalSelfCheckRunner(comp, args, checks=2)
+    runner = interp._SelfCheckRunner(
+        comp, args, checks=2,
+        builder=physical._physical_plan_builder, pin_nonces=False,
+    )
     assert runner.mode == "validating"
 
     order, key_ops, dyn_names, static_env, _ = runner.eager_plan
@@ -245,12 +248,15 @@ def test_physical_selfcheck_demotes_on_corruption():
     from moose_tpu.execution import physical
 
     comp, args, want = _lowered_dot_setup()
-    runner = physical._PhysicalSelfCheckRunner(comp, args, checks=1)
+    runner = interp._SelfCheckRunner(
+        comp, args, checks=1,
+        builder=physical._physical_plan_builder, pin_nonces=False,
+    )
     order, key_ops, dyn_names, static_env, _ = runner.eager_plan
     dyn = {n: np.asarray(args[n]) for n in dyn_names}
     keys = {n: np.arange(4, dtype=np.uint32) + 7 for n in key_ops}
 
-    real_jit = runner._impl._jit_fn
+    real_jit = runner._jit_fn
 
     def corrupted(ks, d):
         outputs, saves = real_jit(ks, d)
@@ -261,9 +267,9 @@ def test_physical_selfcheck_demotes_on_corruption():
         }
         return bad, saves
 
-    runner._impl._jit_fn = corrupted
+    runner._jit_fn = corrupted
     out, _ = runner.run(keys, dyn)
     (val,) = [interp._to_user_value(v) for v in out.values()]
     np.testing.assert_allclose(np.asarray(val), want, atol=1e-5)
     assert runner.mode == "validating"
-    assert runner._impl._level == 1
+    assert runner._level == 1
