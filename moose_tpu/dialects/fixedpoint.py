@@ -209,24 +209,7 @@ def max_pool2d(sess, rep, x: RepFixedTensor, pool, strides=None,
     _n, h, w, c = x.tensor.shares[0][0].shape
     from . import ring as _ring
 
-    (p0, p1), (q0, q1) = _ring.resolve_padding(
-        padding, h, w, ph, pw, *strides
-    )
-    if (p0, p1, q0, q1) != (0, 0, 0, 0):
-        import os
-
-        if os.environ.get("MOOSE_TPU_MAXPOOL_ZERO_PAD") != "1":
-            from ..errors import KernelError
-
-            raise KernelError(
-                "padded max_pool2d on a replicated placement pads with "
-                "the ring encoding of 0, while the host kernel pads "
-                "with -inf — negative inputs would silently produce "
-                "different results per placement.  Use VALID padding, "
-                "pad on the host side, or set "
-                "MOOSE_TPU_MAXPOOL_ZERO_PAD=1 to accept zero-padding "
-                "semantics."
-            )
+    _ring.check_maxpool_padding(padding, h, w, ph, pw, *strides)
     patches = rep_ops.im2col(sess, rep, x.tensor, ph, pw, strides, padding)
     taps = ph * pw
     shp = patches.shares[0][0].shape

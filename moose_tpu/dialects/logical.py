@@ -282,7 +282,7 @@ _REP_MATH = {
 EXPANSION_WEIGHTS = {
     "Softmax": 11000, "Sqrt": 13500, "Log": 9500, "Log2": 9500,
     "Div": 4100, "Inverse": 4100, "Exp": 4600, "Sigmoid": 4600,
-    "Pow2": 4600, "Argmax": 3000, "MaxPool2D": 3000,
+    "Pow2": 4600, "Argmax": 3000, "MaxPool2D": 3000, "AvgPool2D": 150,
     "Maximum": 2000, "Less": 950, "Greater": 950, "Equal": 1200,
     "Sign": 950, "Abs": 1000, "Relu": 1000, "Mux": 200,
     "Dot": 170, "Mul": 130, "Conv2D": 250,

@@ -949,6 +949,31 @@ def resolve_padding(padding, h, w, kh, kw, sh, sw):
     return (int(p0), int(p1)), (int(q0), int(q1))
 
 
+def check_maxpool_padding(padding, h, w, kh, kw, sh, sw):
+    """Shared padding policy for secret max pooling (per-host replicated
+    and stacked backends): implicit padding would pad with the ring
+    encoding of 0, while the host kernel pads with -inf — negative
+    inputs would silently produce different results per placement.
+    Rejected unless MOOSE_TPU_MAXPOOL_ZERO_PAD=1 explicitly accepts
+    zero-padding semantics."""
+    (p0, p1), (q0, q1) = resolve_padding(padding, h, w, kh, kw, sh, sw)
+    if (p0, p1, q0, q1) == (0, 0, 0, 0):
+        return
+    import os
+
+    if os.environ.get("MOOSE_TPU_MAXPOOL_ZERO_PAD") == "1":
+        return
+    from ..errors import KernelError
+
+    raise KernelError(
+        "padded max_pool2d on a secret-shared placement pads with the "
+        "ring encoding of 0, while the host kernel pads with -inf — "
+        "negative inputs would silently produce different results per "
+        "placement.  Use VALID padding, pad on the host side, or set "
+        "MOOSE_TPU_MAXPOOL_ZERO_PAD=1 to accept zero-padding semantics."
+    )
+
+
 def im2col(x, kh: int, kw: int, strides, padding):
     """Extract conv patches from an NHWC array of ANY dtype.
 

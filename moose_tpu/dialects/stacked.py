@@ -332,6 +332,33 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         }[kind]
         return fn()
 
+    if kind == "Conv2D":
+        x = to_rep(sess, args[0])
+        k = to_rep(sess, args[1])
+        if x.fractional_precision != k.fractional_precision:
+            from ..errors import TypeMismatchError
+
+            raise TypeMismatchError(
+                "conv operands disagree on fractional precision: "
+                f"{x.fractional_precision} vs {k.fractional_precision}"
+            )
+        return spmd.fx_conv2d(
+            sess.spmd, x, k,
+            strides=tuple(op.attributes.get("strides", (1, 1))),
+            padding=op.attributes.get("padding", "VALID"),
+        )
+
+    if kind in ("AvgPool2D", "MaxPool2D"):
+        x = to_rep(sess, args[0])
+        pool = tuple(op.attributes["pool_size"])
+        strides = op.attributes.get("strides")
+        strides = tuple(strides) if strides is not None else None
+        padding = op.attributes.get("padding", "VALID")
+        fn = (
+            sm.fx_avg_pool2d if kind == "AvgPool2D" else sm.fx_max_pool2d
+        )
+        return fn(sess.spmd, x, pool, strides, padding)
+
     if kind == "AddN":
         vals = [to_rep(sess, a) for a in args]
         out = vals[0]
@@ -520,7 +547,7 @@ _REP_KINDS = frozenset({
     "Mean", "Exp", "Log", "Log2", "Sqrt", "Sigmoid", "Relu", "Abs",
     "Softmax", "Argmax", "Maximum", "Concat", "Reshape", "ExpandDims",
     "Squeeze", "Transpose", "IndexAxis", "Slice", "Shape", "Cast",
-    "Decrypt",
+    "Decrypt", "Conv2D", "AvgPool2D", "MaxPool2D",
 })
 
 

@@ -262,6 +262,71 @@ def dot(sess: SpmdSession, x: SpmdRep, y: SpmdRep) -> SpmdRep:
     return _reshare(sess, v_lo, v_hi, x.width)
 
 
+def _conv_contract(strides, padding):
+    """Party-batched ring convolution (NHWC x HWIO), the conv analogue
+    of :func:`_dot_contract`: ``ring.conv2d`` (im2col + limb matmul)
+    vmapped over the party axis."""
+
+    def contract(a_lo, a_hi, b_lo, b_hi):
+        if a_hi is None:
+            f = jax.vmap(
+                lambda p, q: ring.conv2d(p, None, q, None, strides,
+                                         padding)[0]
+            )
+            return f(a_lo, b_lo), None
+        f = jax.vmap(
+            lambda p, ph, q, qh: ring.conv2d(p, ph, q, qh, strides,
+                                             padding)
+        )
+        return f(a_lo, a_hi, b_lo, b_hi)
+
+    return contract
+
+
+def conv2d(sess: SpmdSession, x: SpmdRep, k: SpmdRep,
+           strides=(1, 1), padding="VALID") -> SpmdRep:
+    """Secure convolution, stacked form of ``replicated.conv2d``: the
+    cross-product/zero-share-reshare structure of mul/dot with a ring
+    conv as the local contraction."""
+    v_lo, v_hi = _cross_terms(x, k, _conv_contract(strides, padding))
+    return _reshare(sess, v_lo, v_hi, x.width)
+
+
+def im2col(x: SpmdRep, kh: int, kw: int, strides=(1, 1),
+           padding="VALID") -> SpmdRep:
+    """Patch extraction applied share-locally (pure data movement;
+    sharing is linear, so patched shares reconstruct to the patched
+    secret).  The (party, slot) prefix folds into the batch axis for
+    ``ring.im2col`` and unfolds after."""
+
+    def go(a):
+        three, two, n, h, w, c = a.shape
+        flat = a.reshape(three * two * n, h, w, c)
+        patches, out_h, out_w = ring.im2col(flat, kh, kw, strides, padding)
+        return patches.reshape(
+            three, two, n, out_h, out_w, patches.shape[-1]
+        )
+
+    lo = go(x.lo)
+    hi = None if x.hi is None else go(x.hi)
+    return SpmdRep(lo, hi, x.width)
+
+
+def fx_conv2d(sess, x: "SpmdFixed", k: "SpmdFixed",
+              strides=(1, 1), padding="VALID") -> "SpmdFixed":
+    """Fixed-point secure conv: one multiplication depth, fused with the
+    single TruncPr exactly like fx_mul/fx_dot."""
+    z = _mul_like_trunc(
+        sess, x.tensor, k.tensor, _conv_contract(strides, padding),
+        x.fractional_precision,
+    )
+    return SpmdFixed(
+        z,
+        max(x.integral_precision, k.integral_precision),
+        x.fractional_precision,
+    )
+
+
 def mul_public(x: SpmdRep, c_lo, c_hi) -> SpmdRep:
     """x * public constant (same value on every party)."""
     lo, hi = ring.mul(x.lo, x.hi, c_lo, c_hi)

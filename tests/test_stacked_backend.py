@@ -250,6 +250,37 @@ def test_stacked_on_party_mesh():
     np.testing.assert_allclose(np.asarray(got), want, atol=1e-3)
 
 
+def test_resnet_block_onnx_stacked_matches_per_host():
+    """Encrypted convnet inference (Conv2D + pooling + relu + residual
+    skips + softmax head) through from_onnx on the stacked backend;
+    the per-host result is itself float-reference-validated in
+    tests/test_conv.py, so cross-layout agreement pins both."""
+    from moose_tpu import predictors
+    from moose_tpu.predictors.sklearn_export import resnet_block_onnx
+
+    model_proto, _ = resnet_block_onnx(
+        seed=3, in_ch=2, mid_ch=3, size=6, n_classes=2
+    )
+    model = predictors.from_onnx(model_proto.encode())
+    assert isinstance(model, predictors.ConvNet)
+    comp = model.predictor_factory(fixedpoint_dtype=pm.fixed(24, 40))
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(2, 2, 6, 6)) * 0.5  # NCHW like the export
+    args = {"x": x}
+
+    rt_s = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+    (got_s,) = rt_s.evaluate_computation(comp, arguments=args).values()
+    rt_h = LocalMooseRuntime(["alice", "bob", "carole"])
+    (got_h,) = rt_h.evaluate_computation(comp, arguments=args).values()
+    np.testing.assert_allclose(
+        np.asarray(got_s), np.asarray(got_h), atol=2e-3
+    )
+    # probabilities: rows sum to 1
+    np.testing.assert_allclose(
+        np.asarray(got_s).sum(axis=1), 1.0, atol=1e-2
+    )
+
+
 def test_unsupported_graph_falls_back_to_per_host():
     """Graphs with replicated ops outside the stacked dialect's coverage
     still run (per-host fallback), so layout='stacked' is always safe."""
