@@ -28,14 +28,9 @@ def main(argv=None):
     from moose_tpu.computation import HostPlacement
     from moose_tpu.distributed.networking import LocalNetworking
     from moose_tpu.distributed.worker import execute_role
-    from moose_tpu.serde import deserialize_computation
-    from moose_tpu.textual import parse_computation
+    from moose_tpu.serde import load_computation
 
-    data = Path(args.computation).read_bytes()
-    if args.computation.endswith((".moose", ".txt")) or data[:1].isalpha():
-        comp = parse_computation(data.decode())
-    else:
-        comp = deserialize_computation(data)
+    comp = load_computation(args.computation)
 
     arguments = {}
     if args.args:

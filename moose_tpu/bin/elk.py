@@ -20,13 +20,9 @@ from pathlib import Path
 
 
 def _read_computation(path: str):
-    from moose_tpu.serde import deserialize_computation
-    from moose_tpu.textual import parse_computation
+    from moose_tpu.serde import load_computation
 
-    data = Path(path).read_bytes()
-    if path.endswith((".moose", ".txt")) or data[:1].isalpha():
-        return parse_computation(data.decode())
-    return deserialize_computation(data)
+    return load_computation(path)
 
 
 def _write_computation(comp, path: str | None, fmt: str):

@@ -1,0 +1,135 @@
+"""prancer: static-analysis linter for serialized computations.
+
+Runs the graph analyses of :mod:`moose_tpu.compilation.analysis` —
+secrecy/information-flow (MSA1xx), communication pairing/deadlock
+(MSA2xx), signature consistency (MSA3xx), graph hygiene (MSA4xx) — over
+one or more computation files (textual ``.moose`` or msgpack, like the
+rest of the reindeer tool family) and reports every finding.  Exit
+status is 1 if any error-severity diagnostic fired (add
+``--strict-warnings`` to also fail on warnings), so it slots directly
+into CI.
+
+Examples:
+  python -m moose_tpu.bin.prancer comp.moose
+  python -m moose_tpu.bin.prancer lowered.bin --analyses communication,hygiene
+  python -m moose_tpu.bin.prancer comp.moose --passes typing,prune --format json
+  python -m moose_tpu.bin.prancer --explain          # rule catalogue
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _lint_file(path: str, args) -> list:
+    from moose_tpu.compilation.analysis import analyze
+    from moose_tpu.serde import load_computation
+
+    comp = load_computation(path)
+    if args.passes:
+        from moose_tpu.compilation import compile_computation
+
+        passes = [p for p in args.passes.split(",") if p]
+        comp = compile_computation(comp, passes)
+    analyses = None
+    if args.analyses:
+        analyses = [a for a in args.analyses.split(",") if a]
+    ignore = [r for r in (args.ignore or "").split(",") if r]
+    return analyze(comp, analyses=analyses, ignore=ignore)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="prancer",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "computations", nargs="*",
+        help="computation files to lint (textual .moose or msgpack)",
+    )
+    parser.add_argument(
+        "--analyses", default=None,
+        help="comma-separated analyses to run (default: all; "
+             "secrecy,communication,signatures,hygiene)",
+    )
+    parser.add_argument(
+        "--ignore", default=None,
+        help="comma-separated rule ids or family prefixes to suppress "
+             "(e.g. MSA402 or MSA4)",
+    )
+    parser.add_argument(
+        "--passes", default=None,
+        help="compiler passes to run before linting (e.g. "
+             "typing,prune,networking — lint the graph the workers "
+             "would actually execute)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--strict-warnings", action="store_true",
+        help="exit nonzero on warnings too, not just errors",
+    )
+    parser.add_argument(
+        "--explain", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    from moose_tpu.compilation.analysis import RULES, Severity
+
+    if args.explain:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}  {RULES[rule_id]}")
+        return 0
+    if not args.computations:
+        parser.error("no computation files given (or use --explain)")
+
+    threshold = (
+        Severity.WARNING if args.strict_warnings else Severity.ERROR
+    )
+    failed = False
+    records = []
+    counts = {s: 0 for s in Severity}
+    for path in args.computations:
+        try:
+            diagnostics = _lint_file(path, args)
+        except Exception as e:  # noqa: BLE001 — unloadable/uncompilable
+            # file: report it and keep linting the rest of the batch
+            failed = True
+            counts[Severity.ERROR] += 1
+            msg = f"cannot load/compile: {type(e).__name__}: {e}"
+            if args.format == "json":
+                records.append({
+                    "file": path, "rule": "prancer", "severity": "error",
+                    "op": None, "placement": None, "message": msg,
+                })
+            else:
+                print(f"{path}: {msg}", file=sys.stderr)
+            continue
+        for d in diagnostics:
+            counts[d.severity] += 1
+            if d.severity >= threshold:
+                failed = True
+            if args.format == "json":
+                records.append({"file": path, **d.to_dict()})
+            else:
+                print(f"{path}: {d.format()}")
+    if args.format == "json":
+        json.dump(records, sys.stdout, indent=2)
+        print()
+    else:
+        print(
+            f"{len(args.computations)} file(s): "
+            f"{counts[Severity.ERROR]} error(s), "
+            f"{counts[Severity.WARNING]} warning(s), "
+            f"{counts[Severity.INFO]} info(s)"
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,9 +34,16 @@ def compile_computation(
     comp: Computation,
     passes: Optional[list] = None,
     arg_specs: Optional[dict] = None,
+    strict: bool = False,
 ) -> Computation:
     """Run compiler passes over ``comp`` and return the compiled graph
-    (reference compile(), compilation/mod.rs:120-132)."""
+    (reference compile(), compilation/mod.rs:120-132).
+
+    With ``strict=True`` the static analyzer (:mod:`.analysis`) runs
+    after the last pass and error-severity diagnostics (share leak,
+    unpaired rendezvous, signature mismatch, ...) raise
+    :class:`~moose_tpu.errors.MalformedComputationError` — a
+    compile-time reject instead of a runtime hang or leak."""
     from .. import telemetry
 
     if passes is None:
@@ -47,6 +54,12 @@ def compile_computation(
         )
         with telemetry.span(f"pass:{pass_name}"):
             comp = _run_pass(comp, p, arg_specs)
+    # an explicit trailing "lint" pass already checked the final graph
+    if strict and (not passes or passes[-1] != "lint"):
+        from .analysis import lint_check
+
+        with telemetry.span("pass:lint"):
+            lint_check(comp)
     return comp
 
 
@@ -64,6 +77,10 @@ def _run_pass(comp, p, arg_specs):
     if p == "wellformed":
         well_formed_check(comp)
         return comp
+    if p == "lint":
+        from .analysis import lint_check
+
+        return lint_check(comp)
     if p == "dump":
         from ..textual import to_textual
 

@@ -1,0 +1,115 @@
+"""Static analysis over the computation IR ("prancer").
+
+A diagnostics framework plus a rule catalogue that makes graph-level
+invariants machine-checkable before anything runs on a TPU mesh: secrecy
+(secret shares never collected on one host), communication (every
+Receive has a matching Send; no rendezvous deadlock), signature
+consistency (declared input types agree with producers), and hygiene
+(dead ops, CSE candidates).  Analyses *collect* :class:`Diagnostic`
+records instead of raising on the first error; strict callers turn
+error-severity findings into :class:`MalformedComputationError` via
+:func:`lint_check`.
+
+Entry points:
+
+- :func:`analyze` — run some or all analyses, return diagnostics.
+- :func:`lint_check` — analyze and raise on error-severity findings
+  (the ``strict=True`` knob of the elk compiler, and the ``lint``
+  compiler pass).
+- ``python -m moose_tpu.bin.prancer`` — the CLI over serialized
+  computations (textual or msgpack).
+
+Rule id space: ``MSA1xx`` secrecy, ``MSA2xx`` communication, ``MSA3xx``
+signatures, ``MSA4xx`` hygiene.  The full catalogue is in :data:`RULES`
+and documented in DEVELOP.md.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ...computation import Computation
+from ...errors import MalformedComputationError
+from .communication import RULES as _COMM_RULES
+from .communication import analyze_communication
+from .diagnostics import (
+    Diagnostic,
+    Severity,
+    format_diagnostics,
+    max_severity,
+)
+from .hygiene import RULES as _HYGIENE_RULES
+from .hygiene import analyze_hygiene
+from .secrecy import RULES as _SECRECY_RULES
+from .secrecy import analyze_secrecy
+from .signatures import RULES as _SIG_RULES
+from .signatures import analyze_signatures
+
+__all__ = [
+    "ANALYSES", "Diagnostic", "RULES", "Severity", "analyze",
+    "format_diagnostics", "lint_check", "max_severity",
+]
+
+# name -> analysis function; the public registry (prancer's --analyses
+# values and the keys tests select by).
+ANALYSES = {
+    "secrecy": analyze_secrecy,
+    "communication": analyze_communication,
+    "signatures": analyze_signatures,
+    "hygiene": analyze_hygiene,
+}
+
+# rule id -> one-line description (prancer --explain, DEVELOP.md).
+RULES = {
+    **_SECRECY_RULES, **_COMM_RULES, **_SIG_RULES, **_HYGIENE_RULES,
+}
+
+
+def analyze(
+    comp: Computation,
+    analyses: Optional[Iterable[str]] = None,
+    ignore: Iterable[str] = (),
+) -> list[Diagnostic]:
+    """Run the selected analyses (default: all) over ``comp`` and return
+    every finding, most severe first.  ``ignore`` suppresses rule ids
+    (exact, e.g. ``MSA402``) or whole families (prefix, e.g. ``MSA4``).
+    """
+    names = list(ANALYSES) if analyses is None else list(analyses)
+    # a bare string would otherwise iterate per-character and suppress
+    # everything ('M' prefix-matches every rule id)
+    ignored = (ignore,) if isinstance(ignore, str) else tuple(ignore)
+    diagnostics: list[Diagnostic] = []
+    for name in names:
+        try:
+            fn = ANALYSES[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown analysis {name!r}; available: {sorted(ANALYSES)}"
+            ) from None
+        diagnostics.extend(fn(comp))
+    if ignored:
+        diagnostics = [
+            d for d in diagnostics
+            if not any(d.rule.startswith(pat) for pat in ignored)
+        ]
+    diagnostics.sort(key=lambda d: (-d.severity, d.rule, d.op or ""))
+    return diagnostics
+
+
+def lint_check(
+    comp: Computation,
+    analyses: Optional[Iterable[str]] = None,
+    ignore: Iterable[str] = (),
+) -> Computation:
+    """Analyze ``comp`` and raise :class:`MalformedComputationError`
+    carrying the findings if any error-severity diagnostic fired;
+    usable directly as a compiler pass."""
+    diagnostics = analyze(comp, analyses=analyses, ignore=ignore)
+    errors = [d for d in diagnostics if d.severity >= Severity.ERROR]
+    if errors:
+        raise MalformedComputationError(
+            f"computation failed lint with {len(errors)} error(s):\n"
+            + format_diagnostics(errors),
+            diagnostics=errors,
+        )
+    return comp

@@ -27,6 +27,22 @@ def networking_pass(comp: Computation) -> Computation:
     # (producer op name, destination host) -> receive op name
     transfer_cache: dict[tuple, str] = {}
     counter = 0
+    # Generated send_{n}/receive_{n} names must not collide with user ops
+    # (a user op literally named "send_0" would silently overwrite the
+    # generated Send when copied into `out`); skip taken indices.
+    taken = set(comp.operations)
+
+    def fresh_pair() -> tuple[str, str, str]:
+        nonlocal counter
+        while (
+            f"send_{counter}" in taken or f"receive_{counter}" in taken
+        ):
+            counter += 1
+        send_name, recv_name = f"send_{counter}", f"receive_{counter}"
+        rdv = RendezvousKey.from_index(counter).hex()
+        counter += 1
+        taken.update((send_name, recv_name))
+        return send_name, recv_name, rdv
 
     def host_of(op: Operation) -> str:
         plc = comp.placements[op.placement_name]
@@ -49,11 +65,8 @@ def networking_pass(comp: Computation) -> Computation:
             cache_key = (inp, dst)
             recv_name = transfer_cache.get(cache_key)
             if recv_name is None:
-                rdv = RendezvousKey.from_index(counter).hex()
-                counter += 1
+                send_name, recv_name, rdv = fresh_pair()
                 value_ty = producer.signature.return_type
-                send_name = f"send_{counter - 1}"
-                recv_name = f"receive_{counter - 1}"
                 out.operations[send_name] = Operation(
                     name=send_name,
                     kind="Send",

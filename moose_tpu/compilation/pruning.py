@@ -10,11 +10,20 @@ dataflow edge.
 from __future__ import annotations
 
 from ..computation import Computation
+from ..errors import MalformedComputationError
 
 _ROOT_KINDS = ("Output", "Save", "Send")
 
 
-def prune(comp: Computation) -> Computation:
+def reachable_from_roots(
+    comp: Computation, ignore_unknown_inputs: bool = False
+) -> set[str]:
+    """Names of ops reachable (walking inputs backwards) from the
+    Output/Save/Send roots — what :func:`prune` keeps and what the
+    hygiene analysis calls alive.  An input naming a nonexistent op
+    raises :class:`MalformedComputationError` unless
+    ``ignore_unknown_inputs`` (analyses tolerate broken edges and report
+    them under their own rule)."""
     keep: set[str] = set()
     stack = [
         op.name for op in comp.operations.values() if op.kind in _ROOT_KINDS
@@ -26,7 +35,20 @@ def prune(comp: Computation) -> Computation:
         if name in keep:
             continue
         keep.add(name)
-        stack.extend(comp.operations[name].inputs)
+        for inp in comp.operations[name].inputs:
+            if inp not in comp.operations:
+                if ignore_unknown_inputs:
+                    continue
+                raise MalformedComputationError(
+                    f"op {name!r}: input {inp!r} does not exist in the "
+                    f"computation"
+                )
+            stack.append(inp)
+    return keep
+
+
+def prune(comp: Computation) -> Computation:
+    keep = reachable_from_roots(comp)
 
     out = comp.clone_empty()
     for name, op in comp.operations.items():

@@ -2,8 +2,30 @@
 
 from __future__ import annotations
 
-from ..computation import Computation, OPERATOR_SET
+from ..computation import Computation, Operation, OPERATOR_SET
 from ..errors import MalformedComputationError
+
+
+def rendezvous_attr_problems(op: Operation, placements: dict) -> list[str]:
+    """Problems with a Send/Receive op's rendezvous attributes (empty
+    when well-formed).  The ONE definition of the rendezvous contract:
+    raised fail-fast here, collected as MSA203 diagnostics by
+    ``compilation.analysis.communication``."""
+    endpoint_attr = "receiver" if op.kind == "Send" else "sender"
+    problems = []
+    if "rendezvous_key" not in op.attributes:
+        problems.append(f"{op.kind} missing attribute 'rendezvous_key'")
+    endpoint = op.attributes.get(endpoint_attr)
+    if endpoint is None:
+        problems.append(
+            f"{op.kind} missing attribute {endpoint_attr!r}"
+        )
+    elif endpoint not in placements:
+        problems.append(
+            f"{op.kind} {endpoint_attr} {endpoint!r} is not a placement "
+            f"of this computation"
+        )
+    return problems
 
 
 def well_formed_check(comp: Computation) -> Computation:
@@ -36,6 +58,19 @@ def well_formed_check(comp: Computation) -> Computation:
                 f"op {name}: signature arity {op.signature.arity} != "
                 f"{len(op.inputs)} inputs"
             )
-    # cycle check
-    comp.toposort_names()
+        # Send/Receive carry their rendezvous contract in attributes; a
+        # missing key or an endpoint naming a placement outside the
+        # computation hangs the async workers at runtime.
+        if op.kind in ("Send", "Receive"):
+            problems = rendezvous_attr_problems(op, comp.placements)
+            if problems:
+                raise MalformedComputationError(
+                    f"op {name}: {problems[0]}"
+                )
+    # cycle check (toposort raises ValueError; re-raise in the
+    # compilation error taxonomy)
+    try:
+        comp.toposort_names()
+    except ValueError as e:
+        raise MalformedComputationError(str(e)) from e
     return comp

@@ -10,7 +10,8 @@ from typing import Optional
 
 
 def compile_computation(comp_bin: bytes, passes: Optional[list] = None,
-                        arg_specs: Optional[dict] = None) -> bytes:
+                        arg_specs: Optional[dict] = None,
+                        strict: bool = False) -> bytes:
     """Deserialize a msgpack computation, run compiler passes, and return
     the compiled computation re-serialized (the reference returns an
     opaque MooseComputation handle; bytes serve the same role here and
@@ -19,11 +20,18 @@ def compile_computation(comp_bin: bytes, passes: Optional[list] = None,
     ``arg_specs`` supplies the static shapes the lowering pass needs
     (XLA's compilation model): ``{input_name: ((shape...), np_dtype)}``.
     Passes that require no shapes (typing, prune, toposort, wellformed,
-    dot, dump) work without it.
+    lint, dot, dump) work without it.
+
+    ``strict=True`` runs the static analyzer after the passes and raises
+    :class:`~moose_tpu.errors.MalformedComputationError` on any
+    error-severity diagnostic (share leak, unpaired rendezvous,
+    signature mismatch, ...).
     """
     from .compilation import compile_computation as _compile
     from .serde import deserialize_computation, serialize_computation
 
     comp = deserialize_computation(comp_bin)
-    compiled = _compile(comp, passes=passes, arg_specs=arg_specs)
+    compiled = _compile(
+        comp, passes=passes, arg_specs=arg_specs, strict=strict
+    )
     return serialize_computation(compiled)

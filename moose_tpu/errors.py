@@ -29,7 +29,16 @@ class CompilationError(MooseError):
 
 class MalformedComputationError(CompilationError):
     """The computation graph violates well-formedness (reference
-    Error::MalformedComputation / MalformedEnvironment)."""
+    Error::MalformedComputation / MalformedEnvironment).
+
+    When raised by the static analyzer (``compilation.analysis``), the
+    ``diagnostics`` attribute carries the individual
+    ``Diagnostic`` findings so callers can inspect rule ids
+    programmatically instead of parsing the message."""
+
+    def __init__(self, *args, diagnostics=()):
+        super().__init__(*args)
+        self.diagnostics = tuple(diagnostics)
 
 
 class MissingArgumentError(MooseError, KeyError):

@@ -553,6 +553,22 @@ def deserialize_computation(data: bytes) -> Computation:
     return comp
 
 
+def load_computation(path) -> Computation:
+    """Read a computation from ``path`` in either on-disk format: the
+    line-per-op textual form (``.moose``/``.txt`` extension, or a file
+    starting with an ASCII letter) or msgpack.  The shared loader of the
+    CLI tool family (elk, dasher, prancer)."""
+    import pathlib
+
+    from .textual import parse_computation
+
+    path = str(path)
+    data = pathlib.Path(path).read_bytes()
+    if path.endswith((".moose", ".txt")) or data[:1].isalpha():
+        return parse_computation(data.decode())
+    return deserialize_computation(data)
+
+
 # ---------------------------------------------------------------------------
 # Runtime value (de)serialization — the wire format of Send/Receive and of
 # choreography results (the reference bincodes its Value enum,

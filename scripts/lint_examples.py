@@ -1,0 +1,86 @@
+"""Lint the shipped example/tutorial computations with prancer (the CI
+gate for the static analyzer: every graph we ship must be free of
+error-severity diagnostics).
+
+Each target computation is traced, written to a temp ``.moose`` file,
+and linted through the prancer CLI — the same path a user takes with a
+serialized computation.  The tutorial dot product (constants only, so no
+arg specs needed) is additionally run through the full compile pipeline
+and linted post-networking, exercising the MSA2xx communication rules on
+a real Send/Receive graph.
+
+    python scripts/lint_examples.py
+"""
+
+import pathlib
+import sys
+import tempfile
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+# (label, module, attribute) — module-level @pm.computation objects
+TARGETS = [
+    ("tutorial dot product",
+     "tutorials.interfacing_textual_and_cli", "my_computation"),
+    ("logistic regression training", "examples.logistic_regression",
+     "train"),
+    ("logistic regression inference", "examples.logistic_regression",
+     "predict"),
+    ("AES encrypted inference", "examples.aes_inference", "secure_score"),
+]
+
+
+def build_resnet_computation():
+    import moose_tpu as pm
+    from moose_tpu import predictors
+    from moose_tpu.predictors.sklearn_export import resnet_block_onnx
+
+    proto, _ = resnet_block_onnx(seed=7, in_ch=3, mid_ch=4, size=8,
+                                 n_classes=3)
+    model = predictors.from_onnx(proto.encode())
+    return model.predictor_factory(fixedpoint_dtype=pm.fixed(24, 40))
+
+
+def main() -> int:
+    import importlib
+
+    from moose_tpu.bin.prancer import main as prancer
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.edsl import tracer
+    from moose_tpu.textual import to_textual
+
+    graphs = []
+    for label, modname, attr in TARGETS:
+        comp_fn = getattr(importlib.import_module(modname), attr)
+        graphs.append((label, tracer.trace(comp_fn)))
+    graphs.append(
+        ("resnet predictor", tracer.trace(build_resnet_computation()))
+    )
+
+    # full pipeline on the constants-only tutorial graph: lowering,
+    # pruning, networking — the graph the workers would execute
+    logical = graphs[0][1]
+    graphs.append((
+        "tutorial dot product (lowered + networked)",
+        compile_computation(logical, passes=DEFAULT_PASSES),
+    ))
+
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for i, (label, comp) in enumerate(graphs):
+            path = pathlib.Path(tmp) / f"comp_{i}.moose"
+            path.write_text(to_textual(comp))
+            rc = prancer([str(path)])
+            status = "clean" if rc == 0 else "FAILED"
+            print(f"[{status}] {label} ({len(comp.operations)} ops)")
+            failures += rc != 0
+    if failures:
+        print(f"{failures} computation(s) failed lint", file=sys.stderr)
+        return 1
+    print(f"all {len(graphs)} computations lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

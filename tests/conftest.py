@@ -46,3 +46,37 @@ def pytest_configure(config):
         "markers", "slow: long-running end-to-end tests (MPC AES, full "
         "predictor pipelines); deselect with -m 'not slow'"
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def assert_lints_clean():
+    """Assert a computation graph has no static-analysis findings at or
+    above a severity (default: error).  Usage::
+
+        def test_my_graph(assert_lints_clean):
+            assert_lints_clean(comp)                       # no errors
+            assert_lints_clean(comp, fail_on="warning")    # stricter
+            assert_lints_clean(comp, ignore=("MSA4",))     # skip hygiene
+    """
+    from moose_tpu.compilation.analysis import (
+        Severity,
+        analyze,
+        format_diagnostics,
+    )
+
+    def check(comp, analyses=None, ignore=(), fail_on="error"):
+        threshold = (
+            fail_on if isinstance(fail_on, Severity)
+            else Severity.from_str(fail_on)
+        )
+        diagnostics = analyze(comp, analyses=analyses, ignore=ignore)
+        failing = [d for d in diagnostics if d.severity >= threshold]
+        assert not failing, (
+            "graph does not lint clean:\n" + format_diagnostics(failing)
+        )
+        return diagnostics
+
+    return check

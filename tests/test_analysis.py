@@ -1,0 +1,655 @@
+"""Static-analysis subsystem tests: one deliberately bad graph per rule
+family (share leak, unpaired Receive, duplicate rendezvous key, endpoint
+mismatch, wait cycle, signature mismatch, Unit consumption, dead op,
+CSE duplicate), the strict compile knob, the prancer CLI, and the
+``assert_lints_clean`` fixture over real traced/lowered graphs."""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+from moose_tpu.compilation.analysis import (
+    ANALYSES,
+    RULES,
+    Severity,
+    analyze,
+    lint_check,
+)
+from moose_tpu.computation import (
+    Computation,
+    HostFloat64TensorTy,
+    HostPlacement,
+    Operation,
+    ReplicatedPlacement,
+    Signature,
+    UnitTy,
+)
+from moose_tpu.edsl import tracer
+from moose_tpu.errors import MalformedComputationError
+
+F64 = HostFloat64TensorTy
+SIG0 = Signature((), F64)
+SIG1 = Signature((F64,), F64)
+SIG2 = Signature((F64,) * 2, F64)
+
+
+def _hosts(comp, *names):
+    for n in names:
+        comp.add_placement(HostPlacement(n))
+
+
+def rules_of(diagnostics):
+    return {d.rule for d in diagnostics}
+
+
+def _leak_graph():
+    """Secret dot on a replicated placement consumed by a host Add
+    without declassification — the canonical share leak."""
+    comp = Computation()
+    _hosts(comp, "alice", "bob", "carole")
+    comp.add_placement(
+        ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+    )
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("secret", "Dot", ["x", "x"], "rep", SIG2))
+    comp.add_operation(
+        Operation("oops", "Add", ["secret", "secret"], "bob", SIG2)
+    )
+    comp.add_operation(Operation("out", "Output", ["oops"], "bob", SIG1))
+    return comp
+
+
+# ---------------------------------------------------------------------------
+# MSA1xx secrecy
+# ---------------------------------------------------------------------------
+
+
+def test_share_leak_fires_msa101():
+    diags = analyze(_leak_graph(), analyses=["secrecy"])
+    assert "MSA101" in rules_of(diags)
+    (leak,) = [d for d in diags if d.rule == "MSA101"]
+    assert leak.severity is Severity.ERROR
+    assert leak.op == "oops" and leak.placement == "bob"
+    assert "secret" in leak.message
+
+
+def test_taint_propagates_through_host_ops():
+    """Once leaked onto a host, downstream host ops stay tainted until a
+    declassifier; every hop is reported."""
+    comp = _leak_graph()
+    comp.add_operation(
+        Operation("again", "Mul", ["oops", "oops"], "carole", SIG2)
+    )
+    diags = analyze(comp, analyses=["secrecy"])
+    leaks = {d.op for d in diags if d.rule == "MSA101"}
+    assert leaks == {"oops", "again"}
+
+
+def test_declassification_via_cast_is_clean():
+    comp = Computation()
+    _hosts(comp, "alice", "bob", "carole")
+    comp.add_placement(
+        ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+    )
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("secret", "Dot", ["x", "x"], "rep", SIG2))
+    comp.add_operation(Operation("reveal", "Cast", ["secret"], "bob", SIG1))
+    comp.add_operation(Operation("post", "Add", ["reveal", "reveal"],
+                                 "bob", SIG2))
+    comp.add_operation(Operation("out", "Output", ["post"], "bob", SIG1))
+    diags = analyze(comp, analyses=["secrecy"])
+    assert not [d for d in diags if d.severity >= Severity.ERROR]
+    # ... but the declassification point itself is on the audit trail
+    assert "MSA103" in rules_of(diags)
+
+
+def test_identity_move_to_host_warns_msa102():
+    comp = _leak_graph()
+    comp.operations["oops"] = Operation(
+        "oops", "Identity", ["secret"], "bob", SIG1
+    )
+    diags = analyze(comp, analyses=["secrecy"])
+    (d,) = [d for d in diags if d.op == "oops"]
+    assert d.rule == "MSA102" and d.severity is Severity.WARNING
+
+
+def test_identity_reveal_clears_taint_downstream():
+    """The Identity move is the finding; the value is plaintext on the
+    host afterwards, so downstream host ops must NOT escalate to
+    MSA101 errors (the warning would otherwise be an error in
+    disguise under strict compiles)."""
+    comp = _leak_graph()
+    comp.operations["oops"] = Operation(
+        "oops", "Identity", ["secret"], "bob", SIG1
+    )
+    comp.add_operation(Operation("post", "Add", ["oops", "oops"], "bob",
+                                 SIG2))
+    diags = analyze(comp, analyses=["secrecy"])
+    assert [d.rule for d in diags if d.severity >= Severity.ERROR] == []
+    assert {d.rule for d in diags} == {"MSA102"}
+
+
+# ---------------------------------------------------------------------------
+# MSA2xx communication
+# ---------------------------------------------------------------------------
+
+
+def _netted_pair(comp, n, src, dst, key=None):
+    key = key or f"rdv_{n}"
+    comp.add_operation(Operation(
+        f"send_{n}", "Send", [f"val_{n}"], src,
+        Signature((F64,), UnitTy),
+        {"rendezvous_key": key, "receiver": dst},
+    ))
+    comp.add_operation(Operation(
+        f"receive_{n}", "Receive", [], dst, Signature((), F64),
+        {"rendezvous_key": key, "sender": src},
+    ))
+
+
+def test_unpaired_receive_fires_msa201():
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation(
+        "recv", "Receive", [], "bob", Signature((), F64),
+        {"rendezvous_key": "deadbeef", "sender": "alice"},
+    ))
+    comp.add_operation(Operation("out", "Output", ["recv"], "bob", SIG1))
+    diags = analyze(comp, analyses=["communication"])
+    (d,) = [d for d in diags if d.rule == "MSA201"]
+    assert d.op == "recv" and "block forever" in d.message
+
+
+def test_unpaired_send_fires_msa201():
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation("val_0", "Constant", [], "alice", SIG0,
+                                 {"value": 1.0}))
+    comp.add_operation(Operation(
+        "send_0", "Send", ["val_0"], "alice", Signature((F64,), UnitTy),
+        {"rendezvous_key": "deadbeef", "receiver": "bob"},
+    ))
+    diags = analyze(comp, analyses=["communication"])
+    assert "MSA201" in rules_of(diags)
+
+
+def test_duplicate_rendezvous_key_fires_msa202():
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation("val_0", "Constant", [], "alice", SIG0,
+                                 {"value": 1.0}))
+    comp.add_operation(Operation("val_1", "Constant", [], "alice", SIG0,
+                                 {"value": 2.0}))
+    _netted_pair(comp, 0, "alice", "bob", key="samekey")
+    comp.add_operation(Operation(
+        "send_dup", "Send", ["val_1"], "alice", Signature((F64,), UnitTy),
+        {"rendezvous_key": "samekey", "receiver": "bob"},
+    ))
+    diags = analyze(comp, analyses=["communication"])
+    assert "MSA202" in rules_of(diags)
+
+
+def test_endpoint_mismatch_fires_msa203():
+    comp = Computation()
+    _hosts(comp, "alice", "bob", "carole")
+    comp.add_operation(Operation("val_0", "Constant", [], "alice", SIG0,
+                                 {"value": 1.0}))
+    _netted_pair(comp, 0, "alice", "bob")
+    # lie about the receiver: attribute says carole, Receive is on bob
+    comp.operations["send_0"].attributes["receiver"] = "carole"
+    diags = analyze(comp, analyses=["communication"])
+    (d,) = [d for d in diags if d.rule == "MSA203"]
+    assert d.op == "send_0" and "carole" in d.message
+
+
+def test_missing_rendezvous_attrs_fire_msa203():
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation(
+        "recv", "Receive", [], "bob", Signature((), F64), {},
+    ))
+    diags = analyze(comp, analyses=["communication"])
+    assert len([d for d in diags if d.rule == "MSA203"]) == 2
+
+
+def test_wait_cycle_fires_msa204():
+    """alice waits on bob's send, bob waits on alice's send: a classic
+    cross-host rendezvous deadlock (unstitchable by toposort)."""
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    unit_sig = Signature((F64,), UnitTy)
+    comp.add_operation(Operation(
+        "recv_a", "Receive", [], "alice", Signature((), F64),
+        {"rendezvous_key": "kb", "sender": "bob"}))
+    comp.add_operation(Operation(
+        "work_a", "Add", ["recv_a", "recv_a"], "alice", SIG2))
+    comp.add_operation(Operation(
+        "send_a", "Send", ["work_a"], "alice", unit_sig,
+        {"rendezvous_key": "ka", "receiver": "bob"}))
+    comp.add_operation(Operation(
+        "recv_b", "Receive", [], "bob", Signature((), F64),
+        {"rendezvous_key": "ka", "sender": "alice"}))
+    comp.add_operation(Operation(
+        "work_b", "Add", ["recv_b", "recv_b"], "bob", SIG2))
+    comp.add_operation(Operation(
+        "send_b", "Send", ["work_b"], "bob", unit_sig,
+        {"rendezvous_key": "kb", "receiver": "alice"}))
+    diags = analyze(comp, analyses=["communication"])
+    (d,) = [d for d in diags if d.rule == "MSA204"]
+    assert "deadlock" in d.message and "->" in d.message
+
+
+def test_wait_cycle_with_downstream_consumer_terminates():
+    """Regression: nodes downstream of a cycle (an Output consuming the
+    cyclic value) also survive Kahn's peel; the cycle finder must not
+    spin on them."""
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("a", "Add", ["b", "b"], "alice", SIG2))
+    comp.add_operation(Operation("b", "Add", ["a", "a"], "alice", SIG2))
+    comp.add_operation(Operation("out", "Output", ["a"], "alice", SIG1))
+    diags = analyze(comp, analyses=["communication"])
+    (d,) = [d for d in diags if d.rule == "MSA204"]
+    assert d.op in ("a", "b") and "out" not in d.message
+
+
+def test_independent_cycles_each_reported_once():
+    """Regression: two independent deadlock cycles (one feeding the
+    other) must yield exactly one MSA204 each — no duplicates, no
+    misses."""
+    comp = Computation()
+    _hosts(comp, "alice")
+    three = Signature((F64,) * 3, F64)
+    comp.add_operation(Operation("a1", "Add", ["a2", "a2"], "alice", SIG2))
+    comp.add_operation(Operation("a2", "Add", ["a1", "a1"], "alice", SIG2))
+    # b-cycle, with b1 also consuming from the a-cycle
+    comp.add_operation(Operation(
+        "b1", "Concat", ["b2", "b2", "a1"], "alice", three))
+    comp.add_operation(Operation("b2", "Add", ["b1", "b1"], "alice", SIG2))
+    diags = analyze(comp, analyses=["communication"])
+    msa204 = [d for d in diags if d.rule == "MSA204"]
+    assert len(msa204) == 2
+    reported = {frozenset(d.message.split(";")[0]
+                          .removeprefix("wait cycle ")
+                          .split(" in ")[0].split(" -> "))
+                for d in msa204}
+    assert {frozenset({"a1", "a2"}), frozenset({"b1", "b2"})} <= reported
+
+
+def test_missing_endpoint_attr_reported_once():
+    """Regression: a Send missing its receiver attribute gets one MSA203
+    (missing attribute), not a second 'declares receiver=None' mismatch
+    from the pairing check."""
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation("val_0", "Constant", [], "alice", SIG0,
+                                 {"value": 1.0}))
+    comp.add_operation(Operation(
+        "s", "Send", ["val_0"], "alice", Signature((F64,), UnitTy),
+        {"rendezvous_key": "k"}))
+    comp.add_operation(Operation(
+        "r", "Receive", [], "bob", Signature((), F64),
+        {"rendezvous_key": "k", "sender": "alice"}))
+    diags = analyze(comp, analyses=["communication"])
+    msa203 = [d for d in diags if d.rule == "MSA203"]
+    assert len(msa203) == 1 and "missing" in msa203[0].message
+
+
+# ---------------------------------------------------------------------------
+# MSA3xx signatures
+# ---------------------------------------------------------------------------
+
+
+def test_signature_mismatch_fires_msa301():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    fixed_ty = pm.fixed(14, 23)
+    from moose_tpu.computation import tensor_ty
+
+    comp.add_operation(Operation(
+        "y", "Add", ["x", "x"], "alice",
+        Signature((tensor_ty(fixed_ty), F64), F64),
+    ))
+    comp.add_operation(Operation("out", "Output", ["y"], "alice", SIG1))
+    diags = analyze(comp, analyses=["signatures"])
+    (d,) = [d for d in diags if d.rule == "MSA301"]
+    assert d.op == "y" and "HostFloat64Tensor" in d.message
+
+
+def test_arity_mismatch_fires_msa302():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("y", "Add", ["x"], "alice", SIG2))
+    diags = analyze(comp, analyses=["signatures"])
+    assert "MSA302" in rules_of(diags)
+
+
+def test_unit_consumed_as_tensor_fires_msa303():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation(
+        "saved", "Save", ["x"], "alice", Signature((F64,), UnitTy),
+        {"key": "k"},
+    ))
+    comp.add_operation(Operation(
+        "bad", "Add", ["saved", "x"], "alice", SIG2
+    ))
+    diags = analyze(comp, analyses=["signatures"])
+    (d,) = [d for d in diags if d.rule == "MSA303"]
+    assert d.op == "bad"
+    # Output consuming the Unit (the eDSL's `return pm.save(...)` idiom)
+    # stays legal
+    comp.add_operation(Operation(
+        "out", "Output", ["saved"], "alice", Signature((UnitTy,), UnitTy)
+    ))
+    diags = analyze(comp, analyses=["signatures"])
+    assert [d for d in diags if d.rule == "MSA303"] == [d]
+
+
+def test_unknown_input_fires_msa304_not_keyerror():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("y", "Add", ["ghost", "ghost"], "alice",
+                                 SIG2))
+    diags = analyze(comp)  # all analyses must survive the broken edge
+    assert "MSA304" in rules_of(diags)
+
+
+# ---------------------------------------------------------------------------
+# MSA4xx hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_dead_op_fires_msa401():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("dangling", "Add", ["x", "x"], "alice",
+                                 SIG2))
+    comp.add_operation(Operation("out", "Output", ["x"], "alice", SIG1))
+    diags = analyze(comp, analyses=["hygiene"])
+    (d,) = [d for d in diags if d.rule == "MSA401"]
+    assert d.op == "dangling" and d.severity is Severity.WARNING
+
+
+def test_rootless_graph_collapses_to_one_msa401():
+    comp = Computation()
+    _hosts(comp, "alice")
+    for i in range(5):
+        comp.add_operation(Operation(f"c{i}", "Constant", [], "alice",
+                                     SIG0, {"value": float(i)}))
+    diags = analyze(comp, analyses=["hygiene"])
+    msa401 = [d for d in diags if d.rule == "MSA401"]
+    assert len(msa401) == 1 and "no Output/Save/Send roots" in \
+        msa401[0].message
+
+
+def test_cse_candidate_fires_msa402():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("a", "Add", ["x", "x"], "alice", SIG2))
+    comp.add_operation(Operation("b", "Add", ["x", "x"], "alice", SIG2))
+    comp.add_operation(Operation("out", "Output", ["a"], "alice", SIG1))
+    comp.add_operation(Operation("out2", "Output", ["b"], "alice", SIG1))
+    diags = analyze(comp, analyses=["hygiene"])
+    (d,) = [d for d in diags if d.rule == "MSA402"]
+    assert d.op == "b" and "'a'" in d.message
+    assert d.severity is Severity.INFO
+
+
+def test_ndarray_attributes_are_structurally_compared():
+    comp = Computation()
+    _hosts(comp, "alice")
+    same = np.arange(6.0).reshape(2, 3)
+    for name in ("c0", "c1"):
+        comp.add_operation(Operation(
+            name, "Constant", [], "alice", SIG0, {"value": same.copy()}
+        ))
+    comp.add_operation(Operation(
+        "c2", "Constant", [], "alice", SIG0, {"value": same + 1.0}
+    ))
+    for i, src in enumerate(("c0", "c1", "c2")):
+        comp.add_operation(Operation(f"out{i}", "Output", [src], "alice",
+                                     SIG1))
+    diags = analyze(comp, analyses=["hygiene"])
+    msa402 = [d for d in diags if d.rule == "MSA402"]
+    assert [d.op for d in msa402] == ["c1"]  # c2 differs by content
+
+
+# ---------------------------------------------------------------------------
+# Framework: selection, suppression, ordering, strict mode
+# ---------------------------------------------------------------------------
+
+
+def test_every_rule_is_catalogued():
+    assert set(ANALYSES) == {
+        "secrecy", "communication", "signatures", "hygiene"
+    }
+    assert {r[:4] for r in RULES} == {"MSA1", "MSA2", "MSA3", "MSA4"}
+
+
+def test_ignore_suppresses_rule_and_family():
+    comp = _leak_graph()
+    comp.add_operation(Operation("dangling", "Add", ["x", "x"], "alice",
+                                 SIG2))
+    assert "MSA101" not in rules_of(analyze(comp, ignore=("MSA101",)))
+    assert not any(
+        r.startswith("MSA1") for r in rules_of(analyze(comp, ignore=("MSA1",)))
+    )
+    # a bare string means that one rule — NOT per-character prefixes
+    # that would vacuously suppress everything
+    diags = analyze(comp, ignore="MSA101")
+    assert "MSA101" not in rules_of(diags) and diags
+    with pytest.raises(ValueError, match="unknown analysis"):
+        analyze(comp, analyses=["bogus"])
+
+
+def test_diagnostics_sorted_most_severe_first():
+    comp = _leak_graph()
+    comp.add_operation(Operation("dangling", "Add", ["x", "x"], "alice",
+                                 SIG2))
+    diags = analyze(comp)
+    severities = [d.severity for d in diags]
+    assert severities == sorted(severities, reverse=True)
+
+
+def test_lint_check_raises_with_diagnostics_attached():
+    with pytest.raises(MalformedComputationError) as exc_info:
+        lint_check(_leak_graph())
+    err = exc_info.value
+    assert any(d.rule == "MSA101" for d in err.diagnostics)
+    assert "MSA101" in str(err)
+    # clean graph passes through
+    clean = Computation()
+    _hosts(clean, "alice")
+    clean.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                  {"arg_name": "x"}))
+    clean.add_operation(Operation("out", "Output", ["x"], "alice", SIG1))
+    assert lint_check(clean) is clean
+
+
+def test_strict_compile_rejects_leak_graph():
+    """The elk_compiler pipeline knob: strict=True turns error
+    diagnostics into a compile-time MalformedComputationError."""
+    from moose_tpu import elk_compiler
+    from moose_tpu.serde import serialize_computation
+
+    comp_bin = serialize_computation(_leak_graph())
+    # non-strict: passes through untouched
+    elk_compiler.compile_computation(comp_bin, passes=[])
+    with pytest.raises(MalformedComputationError, match="MSA101"):
+        elk_compiler.compile_computation(comp_bin, passes=[], strict=True)
+
+
+def test_lint_as_compiler_pass():
+    with pytest.raises(MalformedComputationError, match="MSA101"):
+        compile_computation(_leak_graph(), passes=["lint"])
+
+
+def test_strict_with_trailing_lint_pass_analyzes_once():
+    """strict=True must not re-run the analyzer when an explicit 'lint'
+    pass already checked the final graph."""
+    from moose_tpu import telemetry
+
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("out", "Output", ["x"], "alice", SIG1))
+    with telemetry.span("test_root"):
+        compile_computation(comp, passes=["lint"], strict=True)
+    root = telemetry.last_trace()
+
+    def count(node, name):
+        return (node.name == name) + sum(
+            count(c, name) for c in node.children
+        )
+
+    assert count(root, "pass:lint") == 1
+
+
+def test_strict_accepts_clean_lowered_graph():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp_fn():
+        with alice:
+            x = pm.cast(pm.constant(np.array([1.0, 2.0]),
+                                    dtype=pm.float64),
+                        dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.mul(x, x)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    traced = tracer.trace(comp_fn)
+    compiled = compile_computation(traced, passes=DEFAULT_PASSES,
+                                   strict=True)
+    assert compiled.operations  # reached the end without raising
+
+
+# ---------------------------------------------------------------------------
+# Fixture + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_passes_on_clean_graph(assert_lints_clean):
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp_fn(x: pm.Argument(placement=alice, dtype=pm.float64)):
+        with alice:
+            y = x + x
+        return y
+
+    diags = assert_lints_clean(tracer.trace(comp_fn), fail_on="warning")
+    assert isinstance(diags, list)
+
+
+def test_fixture_fails_on_leak_graph(assert_lints_clean):
+    with pytest.raises(AssertionError, match="MSA101"):
+        assert_lints_clean(_leak_graph())
+
+
+def test_prancer_cli_text_json_and_exit_codes(tmp_path, capsys):
+    from moose_tpu.bin.prancer import main
+    from moose_tpu.serde import serialize_computation
+    from moose_tpu.textual import to_textual
+
+    bad_moose = tmp_path / "bad.moose"
+    bad_moose.write_text(to_textual(_leak_graph()))
+    bad_bin = tmp_path / "bad.bin"
+    bad_bin.write_bytes(serialize_computation(_leak_graph()))
+
+    assert main([str(bad_moose)]) == 1
+    out = capsys.readouterr().out
+    assert "MSA101" in out and "1 error(s)" in out
+
+    # msgpack input hits the same analyses
+    assert main([str(bad_bin)]) == 1
+    capsys.readouterr()
+
+    # suppressing the family flips the verdict
+    assert main([str(bad_moose), "--ignore", "MSA1"]) == 0
+    capsys.readouterr()
+
+    # JSON format is machine-readable
+    import json
+
+    assert main([str(bad_moose), "--format", "json"]) == 1
+    records = json.loads(capsys.readouterr().out)
+    assert any(r["rule"] == "MSA101" for r in records)
+    assert all(r["file"] == str(bad_moose) for r in records)
+
+    # --explain prints the catalogue
+    assert main(["--explain"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULES:
+        assert rule_id in out
+
+
+def test_prancer_cli_strict_warnings_and_passes(tmp_path, capsys):
+    from moose_tpu.bin.prancer import main
+    from moose_tpu.textual import to_textual
+
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("dangling", "Add", ["x", "x"], "alice",
+                                 SIG2))
+    comp.add_operation(Operation("out", "Output", ["x"], "alice", SIG1))
+    path = tmp_path / "dead.moose"
+    path.write_text(to_textual(comp))
+
+    assert main([str(path)]) == 0  # warning only
+    capsys.readouterr()
+    assert main([str(path), "--strict-warnings"]) == 1
+    capsys.readouterr()
+    # pruning first removes the dead op, so strict warnings pass
+    assert main([str(path), "--passes", "prune",
+                 "--strict-warnings"]) == 0
+    capsys.readouterr()
+
+
+def test_prancer_cli_survives_corrupt_file(tmp_path, capsys):
+    """A corrupt file fails its own lint but must not abort the batch."""
+    from moose_tpu.bin.prancer import main
+    from moose_tpu.textual import to_textual
+
+    corrupt = tmp_path / "corrupt.bin"
+    corrupt.write_bytes(b"\x00\x01not a computation")
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("out", "Output", ["x"], "alice", SIG1))
+    good = tmp_path / "good.moose"
+    good.write_text(to_textual(comp))
+
+    assert main([str(corrupt), str(good)]) == 1
+    captured = capsys.readouterr()
+    assert "cannot load/compile" in captured.err
+    assert "1 error(s)" in captured.out  # the good file still linted
+
+    import json
+
+    assert main([str(corrupt), "--format", "json"]) == 1
+    records = json.loads(capsys.readouterr().out)
+    assert records[0]["rule"] == "prancer"

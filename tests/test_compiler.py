@@ -83,6 +83,153 @@ def test_networking_inserts_send_receive_pair():
     assert order.index(send.name) < order.index(recv.name)
 
 
+def test_networking_name_collision_with_user_ops():
+    """Generated send_{n}/receive_{n} names must not overwrite user ops
+    of the same name (regression: the counter started at 0 regardless of
+    what names the graph already used)."""
+    comp = Computation()
+    comp.add_placement(HostPlacement("alice"))
+    comp.add_placement(HostPlacement("bob"))
+    sig0 = Signature((), HostFloat64TensorTy)
+    two = Signature((HostFloat64TensorTy,) * 2, HostFloat64TensorTy)
+    comp.add_operation(Operation("x", "Input", [], "alice", sig0))
+    # user ops squatting on the generator's first names
+    comp.add_operation(Operation("send_0", "Add", ["x", "x"], "alice", two))
+    comp.add_operation(Operation("receive_0", "Mul", ["x", "x"], "alice",
+                                 two))
+    comp.add_operation(Operation(
+        "out", "Output", ["send_0"], "bob",
+        Signature((HostFloat64TensorTy,), HostFloat64TensorTy)))
+    netted = networking_pass(comp)
+    # nothing was overwritten: all four originals survive with their
+    # kinds, plus exactly one fresh Send/Receive pair
+    assert netted.operations["send_0"].kind == "Add"
+    assert netted.operations["receive_0"].kind == "Mul"
+    assert len(netted.operations) == len(comp.operations) + 2
+    sends = [o for o in netted.operations.values() if o.kind == "Send"]
+    recvs = [o for o in netted.operations.values() if o.kind == "Receive"]
+    assert len(sends) == 1 and len(recvs) == 1
+    assert sends[0].name not in comp.operations
+    assert netted.operations["out"].inputs == [recvs[0].name]
+    # the renamed pair still toposorts and satisfies well-formedness
+    from moose_tpu.compilation.well_formed import well_formed_check
+
+    well_formed_check(netted)
+
+
+def test_networking_separate_sends_per_destination():
+    """The transfer cache dedups per (producer, destination): one value
+    consumed on two different hosts crosses the wire twice, with
+    distinct rendezvous keys."""
+    comp = Computation()
+    for name in ("alice", "bob", "carole"):
+        comp.add_placement(HostPlacement(name))
+    sig0 = Signature((), HostFloat64TensorTy)
+    one = Signature((HostFloat64TensorTy,), HostFloat64TensorTy)
+    comp.add_operation(Operation("x", "Input", [], "alice", sig0))
+    comp.add_operation(Operation("out_b", "Output", ["x"], "bob", one))
+    comp.add_operation(Operation("out_c", "Output", ["x"], "carole", one))
+    netted = networking_pass(comp)
+    sends = [o for o in netted.operations.values() if o.kind == "Send"]
+    recvs = [o for o in netted.operations.values() if o.kind == "Receive"]
+    assert len(sends) == 2 and len(recvs) == 2
+    assert {s.attributes["receiver"] for s in sends} == {"bob", "carole"}
+    keys = {s.attributes["rendezvous_key"] for s in sends}
+    assert len(keys) == 2
+
+
+def test_typing_pass_unknown_producer():
+    from moose_tpu.compilation.typing import typing_pass
+    from moose_tpu.errors import MalformedComputationError
+
+    comp = Computation()
+    comp.add_placement(HostPlacement("alice"))
+    two = Signature((HostFloat64TensorTy,) * 2, HostFloat64TensorTy)
+    comp.add_operation(Operation("y", "Add", ["ghost", "ghost"], "alice",
+                                 two))
+    with pytest.raises(MalformedComputationError,
+                       match=r"y depends on unknown op ghost"):
+        typing_pass(comp)
+
+
+def test_well_formed_cycle_detection_message():
+    from moose_tpu.compilation.well_formed import well_formed_check
+    from moose_tpu.errors import MalformedComputationError
+
+    comp = Computation()
+    comp.add_placement(HostPlacement("alice"))
+    two = Signature((HostFloat64TensorTy,) * 2, HostFloat64TensorTy)
+    comp.add_operation(Operation("a", "Add", ["b", "b"], "alice", two))
+    comp.add_operation(Operation("b", "Add", ["a", "a"], "alice", two))
+    with pytest.raises(MalformedComputationError, match="cycle"):
+        well_formed_check(comp)
+
+
+def test_well_formed_send_receive_attributes():
+    from moose_tpu.compilation.well_formed import well_formed_check
+    from moose_tpu.computation import UnitTy
+    from moose_tpu.errors import MalformedComputationError
+
+    def base():
+        comp = Computation()
+        comp.add_placement(HostPlacement("alice"))
+        comp.add_placement(HostPlacement("bob"))
+        sig0 = Signature((), HostFloat64TensorTy)
+        comp.add_operation(Operation("x", "Input", [], "alice", sig0))
+        return comp
+
+    # missing rendezvous_key
+    comp = base()
+    comp.add_operation(Operation(
+        "s", "Send", ["x"], "alice",
+        Signature((HostFloat64TensorTy,), UnitTy), {"receiver": "bob"}))
+    with pytest.raises(MalformedComputationError,
+                       match="missing attribute 'rendezvous_key'"):
+        well_formed_check(comp)
+
+    # Receive missing sender
+    comp = base()
+    comp.add_operation(Operation(
+        "r", "Receive", [], "bob", Signature((), HostFloat64TensorTy),
+        {"rendezvous_key": "aa"}))
+    with pytest.raises(MalformedComputationError,
+                       match="missing attribute 'sender'"):
+        well_formed_check(comp)
+
+    # receiver naming a placement the computation doesn't have
+    comp = base()
+    comp.add_operation(Operation(
+        "s", "Send", ["x"], "alice",
+        Signature((HostFloat64TensorTy,), UnitTy),
+        {"rendezvous_key": "aa", "receiver": "mallory"}))
+    with pytest.raises(MalformedComputationError,
+                       match="'mallory' is not a placement"):
+        well_formed_check(comp)
+
+    # a correct pair passes
+    comp = base()
+    comp.add_operation(Operation(
+        "s", "Send", ["x"], "alice",
+        Signature((HostFloat64TensorTy,), UnitTy),
+        {"rendezvous_key": "aa", "receiver": "bob"}))
+    comp.add_operation(Operation(
+        "r", "Receive", [], "bob", Signature((), HostFloat64TensorTy),
+        {"rendezvous_key": "aa", "sender": "alice"}))
+    well_formed_check(comp)
+
+
+def test_prune_unknown_input_raises_malformed():
+    from moose_tpu.errors import MalformedComputationError
+
+    comp = Computation()
+    comp.add_placement(HostPlacement("alice"))
+    one = Signature((HostFloat64TensorTy,), HostFloat64TensorTy)
+    comp.add_operation(Operation("out", "Output", ["ghost"], "alice", one))
+    with pytest.raises(MalformedComputationError,
+                       match=r"'out': input 'ghost' does not exist"):
+        prune(comp)
+
+
 def test_networking_dedupes_per_destination():
     comp = Computation()
     comp.add_placement(HostPlacement("alice"))
