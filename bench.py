@@ -107,8 +107,15 @@ def tpu_numerics_check():
     return True
 
 
-def _bench_predictor(comp, args, check, batch, layout=None, iters=5):
+def _bench_predictor(comp, args, check, batch, layout=None, iters=5,
+                     windows=1, window_gap_s=0.0):
     """Median steady-state latency/throughput of one predictor comp.
+
+    ``windows > 1`` repeats the measurement in separated windows (same
+    runtime, so the validated-jit plan stays resolved) and reports the
+    best window as the headline with every window's median in
+    ``info["window_medians"]`` — the defense against the dev tunnel's
+    minute-scale bimodality (VERDICT r5 #3).
 
     Opts in to TPU jit for heavy protocol graphs despite the documented
     experimental-backend miscompile risk (DEVELOP.md "Known issue") —
@@ -169,16 +176,42 @@ def _bench_predictor(comp, args, check, batch, layout=None, iters=5):
         raise payload
     out = payload
     check(out)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
+    # drive the validated-jit ladder to steady state before timing:
+    # validating evaluations execute the eager reference (plus the
+    # candidate), so timing them would measure the ladder, not the
+    # resolved plan
+    for _ in range(10):
+        if runtime.last_plan.get("plan_state") != "validating":
+            break
         runtime.evaluate_computation(comp, arguments=args)
-        times.append(time.perf_counter() - t0)
-    latency = float(np.median(times))
-    return batch / latency, latency
+    medians = []
+    for wi in range(max(1, windows)):
+        if wi:
+            if not _within_budget():
+                break
+            time.sleep(window_gap_s)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            runtime.evaluate_computation(comp, arguments=args)
+            times.append(time.perf_counter() - t0)
+        medians.append(float(np.median(times)))
+    latency = float(np.min(medians))  # best window's median
+    # resolved plan shape of the steady-state evaluations (which ladder
+    # mode the validated-jit self-check settled on, and which ops the
+    # per-op rung pinned eager) — recorded in the bench JSON so a
+    # regression shows up as a mode flip, not just a slow number
+    info = {
+        "plan_mode": runtime.last_timings.get("plan_mode"),
+        "pinned_ops": list(runtime.last_timings.get("pinned_ops", ())),
+        "layout": runtime.last_plan.get("layout"),
+        "window_medians": medians,
+    }
+    return batch / latency, latency, info
 
 
-def bench_logreg_inference(batch=128, features=100, layout=None, iters=5):
+def bench_logreg_inference(batch=128, features=100, layout=None, iters=5,
+                           windows=1, window_gap_s=0.0):
     """North-star metric: encrypted inferences/sec through the ONNX
     predictor path (BASELINE.md north-star section).  ``layout="stacked"``
     measures the SAME user path on the party-stacked SPMD backend
@@ -203,7 +236,8 @@ def bench_logreg_inference(batch=128, features=100, layout=None, iters=5):
         assert err < 5e-3, f"logreg mismatch: {err}"
 
     return _bench_predictor(
-        comp, {"x": x}, check, batch, layout=layout, iters=iters
+        comp, {"x": x}, check, batch, layout=layout, iters=iters,
+        windows=windows, window_gap_s=window_gap_s,
     )
 
 
@@ -454,7 +488,7 @@ def main():
     # 100 features, fixed(24,40)) via from_onnx + LocalMooseRuntime
     try:
         if _within_budget():
-            infer_per_sec, infer_latency = bench_logreg_inference()
+            infer_per_sec, infer_latency, _ = bench_logreg_inference()
             record["logreg_infer_per_sec"] = infer_per_sec
             record["logreg_infer_batch128_latency_s"] = infer_latency
         else:  # cold caches ate the budget; keep the headline on time
@@ -466,14 +500,14 @@ def main():
     # BASELINE.json configs: batch-1024 encrypted inference
     try:
         if _within_budget():
-            record["logreg_infer_batch1024_per_sec"], _ = (
+            record["logreg_infer_batch1024_per_sec"], _, _ = (
                 bench_logreg_inference(batch=1024)
             )
     except Exception as e:
         print(f"# logreg batch-1024 bench failed: {e}")
     try:
         if _within_budget():
-            record["mlp_infer_batch1024_per_sec"], _ = (
+            record["mlp_infer_batch1024_per_sec"], _, _ = (
                 bench_mlp_inference(batch=1024)
             )
     except Exception as e:
@@ -481,19 +515,35 @@ def main():
     emit()
 
     # user-path stacked backend vs hand-written stacked kernels
-    # (VERDICT r4 #1 done-criterion).  LAST stage by design: on the
-    # experimental TPU backend the predictor's fixed(24,40) protocol
-    # sigmoid trips the known fusion miscompile, the self-check demotes
-    # the plan to eager, and each call costs tens of seconds through
-    # the tunnel — honest, correct, and not allowed to starve the
-    # established metrics above.
+    # (VERDICT r4 #1 done-criterion).  LAST stage by design: recovery
+    # work (per-op ladder rung + cross-layout reroute) should make this
+    # fast, but a regression back to stacked-eager costs tens of
+    # seconds per call through the tunnel — honest, correct, and not
+    # allowed to starve the established metrics above.  Sampled across
+    # >= 3 separated windows (VERDICT r5 #3: the tunnel's minute-scale
+    # bimodality makes one window unrepresentative): per-window medians
+    # are recorded as window_medians, the best window is the headline.
     try:
         if _within_budget():
-            per_sec_s, lat_s = bench_logreg_inference(
-                layout="stacked", iters=3
+            n_windows = int(os.environ.get("MOOSE_TPU_BENCH_WINDOWS", "3"))
+            gap_s = float(
+                os.environ.get("MOOSE_TPU_BENCH_WINDOW_GAP_S", "25")
+            )
+            per_sec_s, lat_s, plan_info = bench_logreg_inference(
+                layout="stacked", iters=3, windows=n_windows,
+                window_gap_s=gap_s,
             )
             record["logreg_infer_per_sec_stacked_userpath"] = per_sec_s
             record["logreg_stacked_userpath_latency_s"] = lat_s
+            # per-window latency medians; the headline above is the best
+            # window's (the spread IS the bimodality evidence)
+            record["window_medians"] = plan_info.get("window_medians", [])
+            record["plan_mode"] = plan_info.get("plan_mode")
+            record["pinned_ops"] = len(plan_info.get("pinned_ops") or ())
+            record["pinned_op_names"] = list(
+                plan_info.get("pinned_ops") or ()
+            )
+            record["stacked_userpath_layout"] = plan_info.get("layout")
             per_sec_h, lat_h = bench_logreg_handwritten()
             record["logreg_infer_per_sec_handwritten"] = per_sec_h
             emit()

@@ -39,6 +39,7 @@ from ..computation import (
     Operation,
     ReplicatedPlacement,
 )
+from ..errors import TypeMismatchError
 from ..execution.session import EagerSession
 from ..parallel import spmd
 from ..parallel import spmd_math as sm
@@ -125,8 +126,14 @@ def _share_ring(sess: StackedSession, t: HostRingTensor) -> SpmdRep:
     )
 
 
-def to_rep(sess: StackedSession, v):
-    """Materialize any logical value as a party-stacked sharing."""
+def to_rep(sess: StackedSession, v, width: Optional[int] = None):
+    """Materialize any logical value as a party-stacked sharing.
+
+    ``width`` picks the ring for SECRET INTEGER lifts (the value itself
+    carries no ring): callers derive it from the consuming op's
+    signature via :func:`_op_ring_width` so an integer operand meeting
+    ring128 neighbours lifts at 128 instead of the old hard-coded 64
+    (ADVICE r5 low #1)."""
     if isinstance(v, _STACKED_VALUES):
         return v
     if isinstance(v, HostFixedTensor):
@@ -151,14 +158,18 @@ def to_rep(sess: StackedSession, v):
     if isinstance(v, HostTensor):
         if v.dtype is not None and v.dtype.is_integer:
             # integer dialect lift (reference integer/mod.rs:12-15)
-            ring64 = sess.host.ring_fixedpoint_encode(v.plc, v, 0, 64)
-            return _share_ring(sess, ring64)
-        raise TypeError(
+            ring = sess.host.ring_fixedpoint_encode(
+                v.plc, v, 0, width or 64
+            )
+            return _share_ring(sess, ring)
+        raise TypeMismatchError(
             "cannot share a plaintext float tensor; cast to a fixed "
             "dtype first (reference requires FixedpointEncode before "
             "Share)"
         )
-    raise TypeError(f"cannot share {type(v).__name__} in stacked layout")
+    raise TypeMismatchError(
+        f"cannot share {type(v).__name__} in stacked layout"
+    )
 
 
 def to_host(sess: StackedSession, plc_name: str, v):
@@ -261,7 +272,11 @@ def _public_binop(sess, x: SpmdFixed, pub: Mir3FixedTensor, kind: str,
     """x (+|-|*) mirrored-public value without sharing rounds (stacked
     form of the fixedpoint Mir ops, logical._rep_public_binop)."""
     values, pub_f = logical._mirrored_to_public_ring(pub)
-    assert pub_f == x.fractional_precision
+    if pub_f != x.fractional_precision:
+        raise TypeMismatchError(
+            f"{kind} operands disagree on fractional precision: "
+            f"{x.fractional_precision} vs mirrored {pub_f}"
+        )
     c = values[0]
     if kind == "Add":
         return _fx(spmd.add_public(x.tensor, c.lo, c.hi), x)
@@ -277,13 +292,38 @@ def _public_binop(sess, x: SpmdFixed, pub: Mir3FixedTensor, kind: str,
     raise ValueError(kind)
 
 
+def _op_ring_width(op: Operation) -> Optional[int]:
+    """Ring width for secret-integer lifts, read off the op signature:
+    any fixed-point dtype among the return/input types decides (an
+    integer operand of a ring128 op must lift at 128 — ADVICE r5 low
+    #1); explicit Ring-typed signatures decide by name; ``None`` means
+    no evidence (``to_rep`` then defaults to 64, the integer dialect's
+    native ring)."""
+    sig = op.signature
+    for ty in (sig.return_type, *sig.input_types):
+        d = getattr(ty, "dtype", None)
+        if d is not None and d.is_fixedpoint:
+            return 64 if d.name == "fixed64" else 128
+    for ty in (sig.return_type, *sig.input_types):
+        name = getattr(ty, "name", "") or ""
+        if "Ring128" in name:
+            return 128
+        if "Ring64" in name:
+            return 64
+    return None
+
+
 def _execute_rep(sess: StackedSession, comp, op: Operation,
                  rep: ReplicatedPlacement, args):
     kind = op.kind
     ret_dtype = op.signature.return_type.dtype
+    lift_width = _op_ring_width(op)
+
+    def as_rep(v):
+        return to_rep(sess, v, width=lift_width)
 
     if kind == "Identity":
-        return to_rep(sess, args[0])
+        return as_rep(args[0])
 
     if kind == "Constant":
         host_op = Operation(
@@ -294,19 +334,17 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         h = logical._constant_on_host(sess.host, rep.owners[0], host_op)
         if isinstance(h, (HostShape, HostString)):
             return h
-        return to_rep(sess, h)
+        return as_rep(h)
 
     if kind in ("Add", "Sub", "Mul", "Dot", "Div"):
         x, y = args
         if isinstance(y, Mir3FixedTensor) and kind in ("Add", "Sub", "Mul"):
-            return _public_binop(sess, to_rep(sess, x), y, kind, right=True)
+            return _public_binop(sess, as_rep(x), y, kind, right=True)
         if isinstance(x, Mir3FixedTensor) and kind in ("Add", "Sub", "Mul"):
-            return _public_binop(sess, to_rep(sess, y), x, kind, right=False)
-        xr, yr = to_rep(sess, x), to_rep(sess, y)
+            return _public_binop(sess, as_rep(y), x, kind, right=False)
+        xr, yr = as_rep(x), as_rep(y)
         bare_x, bare_y = isinstance(xr, SpmdRep), isinstance(yr, SpmdRep)
         if bare_x != bare_y:
-            from ..errors import TypeMismatchError
-
             raise TypeMismatchError(
                 f"{kind} mixes a secret integer with a secret fixed-point "
                 f"tensor (got {type(xr).__name__} and {type(yr).__name__})"
@@ -333,11 +371,9 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return fn()
 
     if kind == "Conv2D":
-        x = to_rep(sess, args[0])
-        k = to_rep(sess, args[1])
+        x = as_rep(args[0])
+        k = as_rep(args[1])
         if x.fractional_precision != k.fractional_precision:
-            from ..errors import TypeMismatchError
-
             raise TypeMismatchError(
                 "conv operands disagree on fractional precision: "
                 f"{x.fractional_precision} vs {k.fractional_precision}"
@@ -349,7 +385,7 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         )
 
     if kind in ("AvgPool2D", "MaxPool2D"):
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         pool = tuple(op.attributes["pool_size"])
         strides = op.attributes.get("strides")
         strides = tuple(strides) if strides is not None else None
@@ -360,7 +396,7 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return fn(sess.spmd, x, pool, strides, padding)
 
     if kind == "AddN":
-        vals = [to_rep(sess, a) for a in args]
+        vals = [as_rep(a) for a in args]
         out = vals[0]
         for v in vals[1:]:
             out = (
@@ -371,14 +407,14 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return out
 
     if kind == "Neg":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         if isinstance(x, SpmdFixed):
             return _fx(spmd.neg(x.tensor), x)
         return spmd.neg(x)
 
     if kind in ("Less", "Greater", "Equal"):
-        x = to_rep(sess, args[0])
-        y = to_rep(sess, args[1])
+        x = as_rep(args[0])
+        y = as_rep(args[1])
         xt = x.tensor if isinstance(x, SpmdFixed) else x
         yt = y.tensor if isinstance(y, SpmdFixed) else y
         if kind == "Less":
@@ -388,34 +424,39 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return sm.equal_bit(sess.spmd, xt, yt)
 
     if kind in ("And", "Or", "Xor"):
-        x = to_rep(sess, args[0])
-        y = to_rep(sess, args[1])
+        x = as_rep(args[0])
+        y = as_rep(args[1])
         if kind == "Xor":
             return sm.bits_xor(x, y)
         fn = sm.bits_and if kind == "And" else sm.bits_or
         return fn(sess.spmd, x, y)
 
     if kind == "Mux":
-        s = to_rep(sess, args[0])
-        x = to_rep(sess, args[1])
-        y = to_rep(sess, args[2])
-        assert isinstance(s, SpmdBits), (
-            f"stacked Mux selector must be shared bits, got "
-            f"{type(s).__name__}"
-        )
+        s = as_rep(args[0])
+        x = as_rep(args[1])
+        y = as_rep(args[2])
+        if not isinstance(s, SpmdBits):
+            raise TypeMismatchError(
+                f"stacked Mux selector must be shared bits, got "
+                f"{type(s).__name__}"
+            )
         if isinstance(x, SpmdRep):
             return sm.mux_bit(sess.spmd, s, x, y)
+        if not isinstance(x, SpmdFixed) or not isinstance(y, SpmdFixed):
+            raise TypeMismatchError(
+                f"stacked Mux branches must both be secret fixed or "
+                f"both secret ring tensors, got {type(x).__name__} and "
+                f"{type(y).__name__}"
+            )
         out = sm.mux_bit(sess.spmd, s, x.tensor, y.tensor)
         return _fx(out, x)
 
     if kind in ("Sum", "Mean"):
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         axis = op.attributes.get("axis")
         if isinstance(x, SpmdRep):
             # secret integer tensor (bare ring shares)
             if kind == "Mean":
-                from ..errors import TypeMismatchError
-
                 raise TypeMismatchError(
                     "Mean on secret uint64 is undefined (ring division); "
                     "cast to a fixed dtype first"
@@ -429,33 +470,31 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return fn(sess, x, axis)
 
     if kind in _FX_MATH:
-        return _FX_MATH[kind](sess.spmd, to_rep(sess, args[0]))
+        return _FX_MATH[kind](sess.spmd, as_rep(args[0]))
 
     if kind == "Relu":
-        return _relu(sess, to_rep(sess, args[0]))
+        return _relu(sess, as_rep(args[0]))
 
     if kind == "Abs":
-        return _abs(sess, to_rep(sess, args[0]))
+        return _abs(sess, as_rep(args[0]))
 
     if kind == "Softmax":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         return sm.fx_softmax(
             sess.spmd, x, op.attributes["axis"],
             upmost_index=op.attributes.get("upmost_index"),
         )
 
     if kind == "Argmax":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         return sm.fx_argmax(
             sess.spmd, x, op.attributes["axis"],
             upmost_index=op.attributes.get("upmost_index"),
         )
 
     if kind == "Maximum":
-        vals = [to_rep(sess, a) for a in args]
+        vals = [as_rep(a) for a in args]
         if isinstance(vals[0], SpmdRep):
-            from ..errors import TypeMismatchError
-
             raise TypeMismatchError(
                 "Maximum on secret uint64 needs a signed comparison "
                 "convention; cast to a fixed dtype first"
@@ -463,7 +502,7 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return sm.fx_maximum(sess.spmd, vals)
 
     if kind == "Concat":
-        vals = [to_rep(sess, a) for a in args]
+        vals = [as_rep(a) for a in args]
         axis = op.attributes.get("axis", 0)
         if isinstance(vals[0], SpmdRep):
             return spmd.concat(vals, axis)
@@ -471,14 +510,14 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return _fx(out, vals[0])
 
     if kind == "Reshape":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         shp = to_host(sess, rep.owners[0], args[1])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         out = spmd.reshape(inner, tuple(shp.value))
         return _fx(out, x) if isinstance(x, SpmdFixed) else out
 
     if kind == "ExpandDims":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         out = inner
         for a in sorted(op.attributes["axis"]):
@@ -486,19 +525,19 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return _fx(out, x) if isinstance(x, SpmdFixed) else out
 
     if kind == "Squeeze":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         out = _squeeze(inner, op.attributes.get("axis"))
         return _fx(out, x) if isinstance(x, SpmdFixed) else out
 
     if kind == "Transpose":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         out = _transpose(inner, op.attributes.get("axes"))
         return _fx(out, x) if isinstance(x, SpmdFixed) else out
 
     if kind == "IndexAxis":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         out = spmd.index_axis(
             inner, op.attributes["axis"], op.attributes["index"]
@@ -506,23 +545,46 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return _fx(out, x) if isinstance(x, SpmdFixed) else out
 
     if kind == "Slice":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         spec = logical.decode_slice_spec(op.attributes)
         out = _strided_slice(inner, spec)
         return _fx(out, x) if isinstance(x, SpmdFixed) else out
 
     if kind == "Shape":
-        x = to_rep(sess, args[0])
+        x = as_rep(args[0])
         inner = x.tensor if isinstance(x, SpmdFixed) else x
         return HostShape(tuple(inner.shape), rep.owners[0])
 
     if kind == "Cast":
-        x = to_rep(sess, args[0])
-        assert ret_dtype is not None and ret_dtype.is_fixedpoint
-        assert isinstance(x, SpmdFixed)
-        cur_f = x.fractional_precision
+        if ret_dtype is None or not ret_dtype.is_fixedpoint:
+            raise TypeMismatchError(
+                "stacked Cast on a replicated placement must target a "
+                f"fixed-point dtype, got {ret_dtype}"
+            )
+        x = as_rep(args[0])
         new_f = ret_dtype.fractional_precision
+        if isinstance(x, SpmdRep):
+            # secret integer -> fixed: the scale-0 shares scaled up by
+            # 2^f (integer lift + precision move; the lift width above
+            # already follows the target fixed dtype's ring).  A sharing
+            # produced at another width (e.g. by an upstream all-integer
+            # op that lifted at 64) cannot just be relabelled — reject
+            # so the runtime falls back to the per-host path
+            target_w = 64 if ret_dtype.name == "fixed64" else 128
+            if x.width != target_w:
+                raise TypeMismatchError(
+                    f"stacked Cast to {ret_dtype} needs a ring{target_w} "
+                    f"sharing, got ring{x.width}"
+                )
+            t = spmd.shl(x, new_f) if new_f else x
+            return SpmdFixed(t, ret_dtype.integral_precision, new_f)
+        if not isinstance(x, SpmdFixed):
+            raise TypeMismatchError(
+                f"stacked Cast cannot convert {type(x).__name__} to "
+                f"{ret_dtype}"
+            )
+        cur_f = x.fractional_precision
         t = x.tensor
         if new_f > cur_f:
             t = spmd.shl(t, new_f - cur_f)
@@ -571,15 +633,30 @@ def effective_ops(comp: Computation) -> int:
     return total
 
 
+# replicated kinds whose operands must agree on the value family:
+# mixing a secret integer (bare ring shares) with a secret fixed-point
+# tensor has no stacked kernel — _execute_rep raises TypeMismatchError
+# — so supports() keeps such graphs on the per-host path up front
+_MIXED_SENSITIVE_KINDS = frozenset({
+    "Add", "Sub", "Mul", "Dot", "Div", "AddN", "Less", "Greater",
+    "Equal", "Maximum", "Mux", "Concat",
+})
+
+
 def supports(comp: Computation) -> bool:
     """Whether every op of ``comp`` has a stacked execution path.
 
     Host/mirrored placements delegate to the logical dialect (full
     coverage); replicated placements are checked against
-    :data:`_REP_KINDS`.  Dynamic-shape ops (Select) stay on the default
-    backend.  AES decryption IS covered — on the replicated placement
-    only (a host-placement Decrypt of a stacked-shared key would need a
-    reveal; the default backend handles that rare shape).
+    :data:`_REP_KINDS` plus signature-level screens for the value
+    shapes ``_execute_rep``/``to_rep`` reject at dispatch time (ADVICE
+    r5 low #2: a graph that passes supports() should execute, not error
+    mid-run — the runtime additionally catches ``TypeMismatchError``
+    and retries per-host as a belt-and-braces fallback).  Dynamic-shape
+    ops (Select) stay on the default backend.  AES decryption IS
+    covered — on the replicated placement only (a host-placement
+    Decrypt of a stacked-shared key would need a reveal; the default
+    backend handles that rare shape).
     """
     from ..computation import AES_TY_NAMES
 
@@ -592,12 +669,32 @@ def supports(comp: Computation) -> bool:
             return False
         if op.kind == "Decrypt" and not isinstance(plc, ReplicatedPlacement):
             return False
-        if (
-            isinstance(plc, ReplicatedPlacement)
-            and op.kind not in _REP_KINDS
-            and op.kind not in boundary
-        ):
-            return False
+        if isinstance(plc, ReplicatedPlacement):
+            if op.kind not in _REP_KINDS and op.kind not in boundary:
+                return False
+            sig = op.signature
+            ret_dtype = sig.return_type.dtype if sig.return_type else None
+            if op.kind == "Constant" and ret_dtype is not None \
+                    and ret_dtype.is_float:
+                # a plaintext float cannot be shared (to_rep requires a
+                # fixed encode first)
+                return False
+            if op.kind == "Cast" and (
+                ret_dtype is None or not ret_dtype.is_fixedpoint
+            ):
+                # replicated Cast only moves precision within/into the
+                # fixed family; anything else must go via a host
+                return False
+            if op.kind in _MIXED_SENSITIVE_KINDS:
+                dts = [
+                    ty.dtype
+                    for ty in (sig.return_type, *sig.input_types)
+                    if getattr(ty, "dtype", None) is not None
+                ]
+                if any(d.is_integer for d in dts) and any(
+                    d.is_fixedpoint for d in dts
+                ):
+                    return False
         if not isinstance(plc, (HostPlacement, ReplicatedPlacement,
                                 Mirrored3Placement)):
             return False

@@ -65,9 +65,38 @@ def _is_static_scalar(ty_name: str) -> bool:
     return ty_name in ("HostInt", "HostFloat", "HostString")
 
 
+def _fault_kinds() -> frozenset:
+    """Op kinds listed in MOOSE_TPU_SELFCHECK_FAULT (comma-separated):
+    the self-check runners corrupt those ops' results in their JIT
+    CANDIDATES only, forcing a synthetic divergence so the demotion
+    ladder (including the per-op rung's selective pinning) is testable
+    on backends where the real miscompile cannot reproduce.  Read when
+    a candidate is built; never applied outside self-check candidates."""
+    import os
+
+    raw = os.environ.get("MOOSE_TPU_SELFCHECK_FAULT", "")
+    return frozenset(k.strip() for k in raw.split(",") if k.strip())
+
+
+def _fault_perturb(value):
+    """Corrupt every array leaf of one op's result — the synthetic
+    stand-in for a value-dependent miscompiled kernel."""
+    import jax.numpy as jnp
+
+    def bump(leaf):
+        if not hasattr(leaf, "dtype"):
+            return leaf
+        if leaf.dtype == jnp.bool_:
+            return ~leaf
+        return leaf + jnp.ones((), leaf.dtype)
+
+    return jax.tree_util.tree_map(bump, value)
+
+
 def build_plan(comp: Computation, arguments: dict, use_jit: bool,
                segment_limit: Optional[int] = None,
-               jit_segments: bool = True, dialect=None) -> _Plan:
+               jit_segments: bool = True, dialect=None,
+               fault_kinds=frozenset()) -> _Plan:
     dialect = dialect if dialect is not None else logical
     order = comp.toposort_names()
     static_env: dict[str, Any] = {}
@@ -121,7 +150,7 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
     if use_jit and len(order) > limit:
         return _build_segmented_plan(
             comp_ref, order, static_env, dynamic_names, limit, jit_segments,
-            dialect,
+            dialect, fault_kinds,
         )
 
     def core(master_key, dyn: dict):
@@ -137,7 +166,7 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
         saves: dict[tuple[str, str], Any] = {}
         _run_ops(
             sess, comp, order, static_env, env, outputs, saves, dyn,
-            trace_ops, dialect,
+            trace_ops, dialect, fault_kinds,
         )
         return outputs, saves
 
@@ -145,11 +174,13 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
 
 
 def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
-             trace_ops=False, dialect=None):
+             trace_ops=False, dialect=None, fault_kinds=frozenset()):
     """Execute ``names`` in order against ``env`` — the single op-walk
     shared by the whole-graph core and the per-segment cores.  ``dialect``
     selects the execution layout (per-host ``dialects.logical`` by
-    default; ``dialects.stacked`` for the party-stacked SPMD backend)."""
+    default; ``dialects.stacked`` for the party-stacked SPMD backend).
+    ``fault_kinds`` (self-check candidates only) injects a synthetic
+    divergence into ops of the listed kinds — see :func:`_fault_kinds`."""
     dialect = dialect if dialect is not None else logical
     for name in names:
         op = comp.operations[name]
@@ -204,6 +235,8 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
                 )
         else:
             env[name] = dialect.execute_op(sess, comp, op, args)
+        if fault_kinds and op.kind in fault_kinds:
+            env[name] = _fault_perturb(env[name])
 
 
 def heavy_jit_gate(n_ops: int, use_jit: bool) -> bool:
@@ -229,10 +262,15 @@ def heavy_jit_gate(n_ops: int, use_jit: bool) -> bool:
     the experimental backend" — the miscompile threshold is a hardware
     property (~2000 host-op equivalents), so only the explicit
     MOOSE_TPU_TPU_JIT_HEAVY=1 opt-out bypasses validation."""
-    if not use_jit or n_ops <= min(_segment_limit(), 2000):
-        return use_jit
     import os
 
+    if os.environ.get("MOOSE_TPU_SELFCHECK_FORCE") == "1":
+        # testing knob: treat EVERY jitted plan as gated so the
+        # validated-jit ladder (and the MOOSE_TPU_SELFCHECK_FAULT hook)
+        # can be exercised on backends without the real miscompile
+        return False
+    if not use_jit or n_ops <= min(_segment_limit(), 2000):
+        return use_jit
     if os.environ.get("MOOSE_TPU_TPU_JIT_HEAVY") == "1":
         return use_jit
     import jax
@@ -258,6 +296,28 @@ def _selfcheck_runs() -> int:
     return max(0, n)
 
 
+def _per_op_limit() -> int:
+    """Op-count cap on the per-op ladder rung: above this, per-op
+    validation would compile thousands of tiny XLA programs for a plan
+    three segment rungs already rejected, so the ladder skips straight
+    to eager (and the runtime's cross-layout reroute applies).  The rung
+    exists for LOGICAL plans — a stacked predictor is ~40 logical ops
+    each expanding to a whole protocol circuit — where per-op jit is the
+    difference between one op eager and the whole plan eager."""
+    import os
+
+    raw = os.environ.get("MOOSE_TPU_PEROP_MAX", "4000")
+    try:
+        n = int(raw)
+    except ValueError as e:
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            f"MOOSE_TPU_PEROP_MAX must be an integer, got {raw!r}"
+        ) from e
+    return max(0, n)
+
+
 def _results_equal(a, b) -> bool:
     """Bit-exact pytree comparison of two (outputs, saves) results.  The
     eager and jitted paths execute identical integer protocol math from
@@ -277,6 +337,158 @@ def _results_equal(a, b) -> bool:
     return all(eq(x, y) for x, y in zip(la, lb))
 
 
+_PER_OP = "per-op"  # ladder sentinel: per-op-jit rung (not a segment size)
+
+
+class _PerOpPlan:
+    """The per-op rung of the validated-jit ladder: every operation runs
+    as its OWN XLA program, validated bit-exactly against its eager
+    execution on the same inputs, and only the ops that diverge are
+    pinned to eager dispatch — the rest stay jitted.  DEVELOP.md's
+    localization shows every component except one region jits exact, so
+    the steady state is ~one op eager instead of the whole plan (the
+    all-or-nothing terminal demotion this rung replaces).
+
+    Boundary and static ops (Input/Load/Save/Output, baked constants,
+    key feeds) always run eagerly — host-boundary work with nothing to
+    fuse — and are not counted as "pinned"."""
+
+    def __init__(self, order, static_env, dynamic_names, effective_inputs,
+                 seg_exec, fault_kinds, rand_slice, always_eager=(),
+                 seg_invoke=None, pinned=()):
+        chunks, in_names, out_names = plan_segments(
+            order, static_env, effective_inputs, 1
+        )
+        self._chunks = chunks
+        self._in_names = in_names
+        self._out_names = out_names
+        dyn_set = set(dynamic_names)
+        self._dyn_of = [
+            [n for n in names if n in dyn_set] for names in chunks
+        ]
+        self._static_env = static_env
+        self._seg_exec = seg_exec
+        self._fault_kinds = frozenset(fault_kinds)
+        self._rand_slice = rand_slice
+        self._seg_invoke = seg_invoke
+        self._always = set(always_eager) | set(static_env)
+        self._validatable = frozenset(
+            names[0] for names in chunks if names[0] not in self._always
+        )
+        # seeding from a previous runner's pins (the plan registry) lets
+        # promotion survive across runtimes without re-diverging first
+        self.pinned: set = set(pinned) & self._validatable
+        # ops whose jit candidate failed to RUN once (transient OOM,
+        # tunnel hiccup): retried before pinning, mirroring the segment
+        # rungs' retry-once policy
+        self._failed_once: set = set()
+        self._eager_fns = [
+            self._make_seg(si, fault=False) for si in range(len(chunks))
+        ]
+        self._jit_fns: dict = {}
+
+    def _make_seg(self, si, fault):
+        names = self._chunks[si]
+        outs = self._out_names[si]
+        static_env = self._static_env
+        seg_exec = self._seg_exec
+        fk = self._fault_kinds if fault else frozenset()
+
+        def seg(rand, dyn, env_in):
+            env: dict[str, Any] = dict(static_env)
+            env.update(env_in)
+            outputs: dict[str, Any] = {}
+            saves: dict[tuple[str, str], Any] = {}
+            seg_exec(si, names, rand, dyn, env, outputs, saves, fk)
+            return {n: env[n] for n in outs}, outputs, saves
+
+        return seg
+
+    def _jit_fn(self, si):
+        fn = self._jit_fns.get(si)
+        if fn is None:
+            fn = self._jit_fns[si] = jax.jit(self._make_seg(si, fault=True))
+        return fn
+
+    def _call(self, si, fn, rand, dyn, env):
+        args = (
+            self._rand_slice(rand, si),
+            {n: dyn[n] for n in self._dyn_of[si]},
+            {n: env[n] for n in self._in_names[si]},
+        )
+        if self._seg_invoke is not None:
+            return self._seg_invoke(si, fn, *args)
+        return fn(*args)
+
+    @staticmethod
+    def _merge(env, outputs, saves, result):
+        env_out, out_i, sv_i = result
+        env.update(env_out)
+        outputs.update(out_i)
+        saves.update(sv_i)
+
+    def all_pinned(self) -> bool:
+        return self._validatable <= self.pinned
+
+    def run_validate(self, rand, dyn):
+        """One validation pass: every op executes eagerly (the exact
+        reference the returned result comes from) and, where unpinned,
+        also as its own jitted program on the SAME inputs; a divergence
+        pins that op, a candidate RUN failure is retried on the next
+        pass before pinning (the segment rungs' retry-once policy).
+        Returns ((outputs, saves), newly_pinned_names, retried_names)."""
+        from ..logger import get_logger
+
+        env: dict[str, Any] = {}
+        outputs: dict[str, Any] = {}
+        saves: dict[tuple[str, str], Any] = {}
+        new_pins: list[str] = []
+        retried: list[str] = []
+        for si, names in enumerate(self._chunks):
+            ref = self._call(si, self._eager_fns[si], rand, dyn, env)
+            name = names[0]
+            if name in self._validatable and name not in self.pinned:
+                pin = False
+                try:
+                    got = self._call(si, self._jit_fn(si), rand, dyn, env)
+                    pin = not _results_equal(ref, got)
+                except Exception as e:  # noqa: BLE001 — candidate is
+                    # optional; a run failure is not the divergence the
+                    # rung exists for
+                    if name not in self._failed_once:
+                        self._failed_once.add(name)
+                        retried.append(name)
+                        get_logger().warning(
+                            "per-op jit candidate for %s failed to run "
+                            "(%s); will retry once", name, e,
+                        )
+                    else:
+                        get_logger().warning(
+                            "per-op jit candidate for %s failed twice "
+                            "(%s); pinning eager", name, e,
+                        )
+                        pin = True
+                if pin:
+                    self.pinned.add(name)
+                    new_pins.append(name)
+                    self._jit_fns.pop(si, None)
+            self._merge(env, outputs, saves, ref)
+        return (outputs, saves), new_pins, retried
+
+    def run_mixed(self, rand, dyn):
+        """Steady-state execution: pinned/boundary ops eager, everything
+        else as its validated per-op XLA program."""
+        env: dict[str, Any] = {}
+        outputs: dict[str, Any] = {}
+        saves: dict[tuple[str, str], Any] = {}
+        for si, names in enumerate(self._chunks):
+            eager = names[0] in self._always or names[0] in self.pinned
+            fn = self._eager_fns[si] if eager else self._jit_fn(si)
+            self._merge(env, outputs, saves,
+                        self._call(si, fn, rand, dyn, env))
+        return outputs, saves
+
+
 class _SelfCheckBase:
     """Validated-jit execution for heavy graphs on the experimental TPU
     backend (VERDICT r3 weak #1: the blanket eager gate was a perf
@@ -287,9 +499,13 @@ class _SelfCheckBase:
     reference on the plan's first K evaluations — identical randomness,
     so the two paths must agree bit-for-bit.  K clean runs (distinct
     random keys) promote the plan to pure jit; a mismatch demotes the
-    candidate down a segment-size ladder (50-op segments are measured
-    exact where one ~10k-op program miscompiles, DEVELOP.md "Known
-    issue") and, if every rung fails, to eager.
+    candidate down the ladder: whole/default segments → 200-op → 50-op
+    segments (measured exact where one ~10k-op program miscompiles,
+    DEVELOP.md "Known issue") → per-op programs with per-op validation
+    (:class:`_PerOpPlan` — only the ops that actually diverge are
+    pinned eager) → whole-plan eager.  Full exhaustion is surfaced as
+    ``exhausted`` so the runtime can reroute the computation to the
+    other layout's validated path instead of keeping the slow plan.
 
     The underlying backend bug is value-dependent, so K clean runs are
     probabilistic evidence, not proof (the known repro fails on ~2/3 of
@@ -299,20 +515,40 @@ class _SelfCheckBase:
     old absolute guarantee set it to 0.
 
     Subclasses provide ``_build_candidate`` (set ``_ref_fn``/``_jit_fn``
-    for the current ladder level), ``_eager_fn`` (final fallback), and
-    may override ``_invoke`` (e.g. to pin nonce streams)."""
+    — or ``_per_op`` at the per-op rung — for the current ladder
+    level), ``_eager_fn`` (final fallback), and may override ``_invoke``
+    (e.g. to pin nonce streams) and ``_save_state`` (plan registry)."""
 
-    LADDER = (None, 200, 50)  # segment-limit overrides; None = default
+    LADDER = (None, 200, 50, _PER_OP)  # segment overrides; None = default
 
-    def __init__(self, checks: int):
+    def __init__(self, checks: int, level: int = 0,
+                 mode: Optional[str] = None):
         self._checks_init = checks
         self._checks_left = checks
-        self._level = 0
+        self._level = level
         self._ref_fn = None
         self._jit_fn = None
+        self._per_op = None
         self._run_failed_once = False
         self.mode = "validating"
+        if mode == "eager":
+            # restored from the plan registry: a previous runner for
+            # this computation already exhausted the full ladder
+            self.mode = "eager"
+            return
+        # restoring a promoted plan needs no eager reference (validation
+        # never runs again) — let _build_candidate skip constructing it
+        self._skip_ref_build = mode == "jit"
         self._build_candidate()
+        self._skip_ref_build = False
+        if self.LADDER[self._level] is _PER_OP and self._per_op is None:
+            self.mode = "eager"  # per-op rung unbuildable (e.g. op cap)
+            return
+        if mode in ("jit", _PER_OP):
+            # restored promotion (the registry weak-keys resolved plans
+            # on the computation so promotion survives across runtimes)
+            self.mode = mode
+            self._on_promoted()
 
     # -- subclass hooks ----------------------------------------------------
 
@@ -330,7 +566,17 @@ class _SelfCheckBase:
         follow): release everything only validation needed."""
         self._ref_fn = None
 
+    def _save_state(self):
+        """Persist ladder level / pins / mode (subclass hook)."""
+
     # -- state machine -----------------------------------------------------
+
+    @property
+    def exhausted(self) -> bool:
+        """Every rung (including per-op) failed: the plan would run
+        whole-plan eager forever.  The runtime uses this to reroute the
+        computation through the other layout's validated path."""
+        return self.mode == "eager"
 
     def run(self, *args):
         if self.mode == "jit":
@@ -338,10 +584,15 @@ class _SelfCheckBase:
             # _invoke keeps any nonce context for late retraces (new
             # shapes) so their draws match the validated ones
             return self._invoke(self._jit_fn, *args)
+        if self.mode == _PER_OP:
+            return self._per_op.run_mixed(*args)
         if self.mode == "eager":
             return self._eager_fn(*args)
 
         from ..logger import get_logger
+
+        if self._per_op is not None:
+            return self._run_per_op_validation(*args)
 
         ref = self._invoke(self._ref_fn, *args)
         try:
@@ -374,25 +625,104 @@ class _SelfCheckBase:
                     self.LADDER[self._level], self._checks_init,
                 )
                 self._on_promoted()
+                self._save_state()
             return got
+        self._descend()
+        return ref
+
+    def _descend(self):
+        """Move to the next usable ladder rung (or pin eager)."""
+        from ..logger import get_logger
+
         self._level += 1
-        if self._level < len(self.LADDER):
+        while self._level < len(self.LADDER):
+            rung = self.LADDER[self._level]
+            self._build_candidate()
+            if rung is _PER_OP and self._per_op is None:
+                self._level += 1
+                continue
             get_logger().warning(
                 "jit self-check: candidate diverged from eager; retrying "
-                "with %d-op segments", self.LADDER[self._level],
+                "with %s",
+                "per-op programs (divergent ops will be pinned eager)"
+                if rung is _PER_OP else f"{rung}-op segments",
             )
-            self._build_candidate()
             self._checks_left = self._checks_init
             self._run_failed_once = False
-        else:
+            self._save_state()
+            return
+        get_logger().warning(
+            "jit self-check: every rung diverged; plan pinned to eager "
+            "execution"
+        )
+        self.mode = "eager"
+        self._jit_fn = None
+        self._ref_fn = None
+        self._per_op = None
+        self._save_state()
+
+    def _run_per_op_validation(self, *args):
+        from ..logger import get_logger
+
+        try:
+            result, new_pins, retried = self._per_op.run_validate(*args)
+        except Exception as e:  # noqa: BLE001 — candidate is optional
             get_logger().warning(
-                "jit self-check: every segment size diverged; plan "
-                "pinned to eager execution"
+                "per-op jit self-check failed to run (%s); plan pinned "
+                "to eager execution", e
             )
             self.mode = "eager"
-            self._jit_fn = None
-            self._ref_fn = None
-        return ref
+            self._per_op = None
+            self._save_state()
+            return self._eager_fn(*args)
+        if new_pins:
+            get_logger().warning(
+                "per-op jit self-check: pinned %d divergent op(s) "
+                "eager: %s", len(new_pins), ", ".join(sorted(new_pins)),
+            )
+            self._checks_left = self._checks_init
+        elif retried:
+            # some candidates failed to run and get one retry: neither
+            # a clean pass nor a divergence — hold the counter
+            pass
+        else:
+            self._checks_left -= 1
+        if self._per_op.all_pinned():
+            get_logger().warning(
+                "per-op jit self-check: every op diverged; plan pinned "
+                "to eager execution"
+            )
+            self.mode = "eager"
+            self._per_op = None
+        elif self._checks_left <= 0:
+            self.mode = _PER_OP
+            get_logger().info(
+                "per-op jit self-check: plan promoted with %d op(s) "
+                "pinned eager after %d clean runs",
+                len(self._per_op.pinned), self._checks_init,
+            )
+            self._on_promoted()
+        self._save_state()
+        return result
+
+
+# Resolved-plan registry, weak-keyed on the computation: which ladder
+# level a plan settled at, which ops are pinned eager, and the final
+# mode — so promotion (and exhaustion) survives across evaluations,
+# bindings and runtimes instead of re-validating from the top.  Entries
+# are per plan-key ("logical" / "StackedDialect" / "physical"): the same
+# traced computation executes on several backends and their ladders are
+# independent.
+_plan_registry: "weakref.WeakKeyDictionary" = None  # initialized below
+
+
+def _registry():
+    global _plan_registry
+    if _plan_registry is None:
+        import weakref
+
+        _plan_registry = weakref.WeakKeyDictionary()
+    return _plan_registry
 
 
 class _SelfCheckRunner(_SelfCheckBase):
@@ -400,15 +730,17 @@ class _SelfCheckRunner(_SelfCheckBase):
     executors (VERDICT r4 #6: one self-check engine, not two).
 
     Parameterized by a ``builder(comp, arguments, use_jit, segment_limit,
-    jit_segments) -> (plan_obj, executable)`` and by nonce pinning: the
-    logical dialect's kernels draw trace-time sync-key nonces, so its
-    eager reference replays the candidate under a shared deterministic
-    nonce stream (nonces are public; seed security rests on the per-call
-    master key); physical plans take every PRF key as a runtime input
-    with sync keys baked as attributes, so no pinning is needed."""
+    jit_segments) -> (plan_obj, executable)``, a ``per_op_builder`` for
+    the per-op rung, and by nonce pinning: the logical dialect's kernels
+    draw trace-time sync-key nonces, so its eager reference replays the
+    candidate under a shared deterministic nonce stream (nonces are
+    public; seed security rests on the per-call master key); physical
+    plans take every PRF key as a runtime input with sync keys baked as
+    attributes, so no pinning is needed."""
 
     def __init__(self, comp, arguments, checks: int, dialect=None,
-                 builder=None, pin_nonces: bool = True):
+                 builder=None, pin_nonces: bool = True,
+                 per_op_builder=None, plan_key: Optional[str] = None):
         import weakref
 
         # weak: the runner is cached in a weak-keyed dict keyed by the
@@ -422,24 +754,61 @@ class _SelfCheckRunner(_SelfCheckBase):
             else _logical_plan_builder(dialect)
         )
         self._pin_nonces = pin_nonces
+        self._per_op_builder = (
+            per_op_builder
+            if per_op_builder is not None or builder is not None
+            else _logical_per_op_builder(dialect)
+        )
+        self._plan_key = plan_key or (
+            "logical" if dialect is None else type(dialect).__name__
+        )
         # whole-graph eager plan: binding metadata + final fallback
         self.eager_plan, self._eager_exec = self._builder(
             comp, arguments, False, None, True
         )
+        self._order = (
+            self.eager_plan.order
+            if hasattr(self.eager_plan, "order")
+            else self.eager_plan[0]
+        )
         self._nonce_seed = secrets.randbits(63)
-        super().__init__(checks)
+        saved = _registry().get(comp, {}).get(self._plan_key)
+        self._restored_pins = (
+            frozenset(saved["pinned"]) if saved else frozenset()
+        )
+        super().__init__(
+            checks,
+            level=saved["level"] if saved else 0,
+            mode=saved["mode"] if saved else None,
+        )
 
     def _build_candidate(self):
         comp = self._comp_ref()
         if comp is None:  # pragma: no cover - defensive
             raise RuntimeError("computation was garbage-collected")
         limit = self.LADDER[self._level]
+        if limit is _PER_OP:
+            self._jit_fn = None
+            self._ref_fn = None
+            self._per_op = None
+            if self._per_op_builder is not None:
+                self._per_op = self._per_op_builder(
+                    comp, self._arguments, self.eager_plan,
+                    _fault_kinds(), self._nonce_seed,
+                    pinned=self._restored_pins,
+                )
+            return
+        self._per_op = None
         _, self._jit_fn = self._builder(
-            comp, self._arguments, True, limit, True
+            comp, self._arguments, True, limit, True,
+            fault_kinds=_fault_kinds(),
         )
-        _, self._ref_fn = self._builder(
-            comp, self._arguments, True, limit, False
-        )
+        if getattr(self, "_skip_ref_build", False):
+            self._ref_fn = None  # restored promotion: never validated
+        else:
+            _, self._ref_fn = self._builder(
+                comp, self._arguments, True, limit, False
+            )
 
     def _eager_fn(self, *args):
         return self._eager_exec(*args)
@@ -461,20 +830,108 @@ class _SelfCheckRunner(_SelfCheckBase):
     def _with_nonces(self, fn, *args):  # kept for tests/direct callers
         return self._invoke(fn, *args)
 
+    def _save_state(self):
+        comp = self._comp_ref()
+        if comp is None:  # pragma: no cover - defensive
+            return
+        entry = _registry().setdefault(comp, {})
+        entry[self._plan_key] = {
+            "level": self._level,
+            "mode": self.mode,
+            "pinned": (
+                frozenset(self._per_op.pinned)
+                if self._per_op is not None
+                else self._restored_pins
+            ),
+        }
+
+    # -- plan introspection (telemetry / runtime.last_timings) -------------
+
+    @property
+    def pinned_ops(self) -> list:
+        """Names of the ops the per-op rung pinned eager (sorted)."""
+        if self._per_op is not None:
+            return sorted(self._per_op.pinned)
+        return sorted(self._restored_pins) if self.mode == _PER_OP else []
+
+    @property
+    def plan_mode(self) -> str:
+        """The resolved (or currently-validating) plan shape: one of
+        ``whole-graph`` / ``segmented`` / ``per-op`` / ``eager``."""
+        if self.mode == "eager" or self.mode == _PER_OP:
+            return self.mode
+        limit = self.LADDER[self._level]
+        if limit is _PER_OP:
+            return _PER_OP
+        seg = limit if limit is not None else _segment_limit()
+        return "segmented" if len(self._order) > seg else "whole-graph"
+
 
 def _logical_plan_builder(dialect):
     """builder hook for :class:`_SelfCheckRunner` over logical plans."""
 
-    def build(comp, arguments, use_jit, segment_limit, jit_segments):
+    def build(comp, arguments, use_jit, segment_limit, jit_segments,
+              fault_kinds=frozenset()):
         plan = build_plan(
             comp, arguments, use_jit, segment_limit=segment_limit,
             jit_segments=jit_segments, dialect=dialect,
+            fault_kinds=fault_kinds,
         )
         if plan.fn is not None:  # segmented: already assembled
             return plan, plan.fn
         if use_jit and jit_segments:
             return plan, jax.jit(plan.core)
         return plan, plan.core
+
+    return build
+
+
+def _logical_per_op_builder(dialect):
+    """per-op-rung builder hook for logical plans: one session per op
+    (``key_domain = op index + 1``, the same discipline as segmented
+    plans, so PRF streams never collide across ops) and a per-op
+    deterministic nonce stream so each op's eager reference and jit
+    candidate draw identical trace-time sync keys."""
+    d = dialect if dialect is not None else logical
+
+    def build(comp, arguments, eager_plan, fault_kinds, nonce_seed,
+              pinned=()):
+        import weakref
+
+        order = eager_plan.order
+        if len(order) > _per_op_limit():
+            return None
+        static_env = eager_plan.static_env
+        comp_ref = weakref.ref(comp)
+
+        def seg_exec(si, names, master_key, dyn, env, outputs, saves,
+                     fault=frozenset()):
+            comp = comp_ref()
+            if comp is None:  # pragma: no cover - defensive
+                raise RuntimeError("computation was garbage-collected")
+            sess = d.make_session(master_key, key_domain=si + 1)
+            d.bind_placements(sess, comp)
+            _run_ops(
+                sess, comp, names, static_env, env, outputs, saves, dyn,
+                False, d, fault,
+            )
+
+        def seg_invoke(si, fn, *args):
+            from ..dialects import host
+
+            with host.deterministic_sync_keys(nonce_seed + si + 1):
+                return fn(*args)
+
+        always = {
+            n for n in order
+            if comp.operations[n].kind in _BOUNDARY_KINDS
+        }
+        return _PerOpPlan(
+            order, static_env, eager_plan.dynamic_names,
+            lambda n: comp.operations[n].inputs,
+            seg_exec, fault_kinds, lambda mk, si: mk,
+            always_eager=always, seg_invoke=seg_invoke, pinned=pinned,
+        )
 
     return build
 
@@ -604,7 +1061,8 @@ def build_segmented_runner(order, static_env, dynamic_names,
 
 def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
                           limit: Optional[int] = None,
-                          jit_segments: bool = True, dialect=None):
+                          jit_segments: bool = True, dialect=None,
+                          fault_kinds=frozenset()):
     """Logical-plan segmentation: each segment runs its own session over
     the same master key with a distinct key domain, so PRF streams never
     collide across segments."""
@@ -619,7 +1077,7 @@ def _build_segmented_plan(comp_ref, order, static_env, dynamic_names,
         dialect.bind_placements(sess, comp)
         _run_ops(
             sess, comp, names, static_env, env, outputs, saves, dyn,
-            False, dialect,
+            False, dialect, fault_kinds,
         )
 
     run = build_segmented_runner(
@@ -741,7 +1199,41 @@ class Interpreter:
         # with execute_op/to_host/bind_placements/make_session (e.g.
         # dialects.stacked.StackedDialect) selects another backend
         self._dialect = dialect
+        self._plan_key = (
+            "logical" if dialect is None else type(dialect).__name__
+        )
         self._cache = weakref.WeakKeyDictionary()
+        # resolved plan shape of the most recent evaluate() — the
+        # runtime lifts this into last_timings/last_plan
+        self.last_plan_info: dict = {}
+
+    def plan_exhausted(self, comp: Computation, arguments=None,
+                       use_jit: bool = True) -> bool:
+        """Would evaluating this computation run whole-plan eager
+        because its validated-jit ladder already exhausted?  The
+        runtime's cross-layout demotion routing asks this BEFORE
+        dispatching, so an exhausted stacked plan is rerouted to the
+        per-host auto-lowered path instead of pinning stacked-eager."""
+        if not use_jit:
+            return False
+        saved = _registry().get(comp, {}).get(self._plan_key)
+        return bool(saved) and saved.get("mode") == "eager"
+
+    def _plan_info(self, plan, fn) -> dict:
+        runner = getattr(fn, "__self__", None)
+        if isinstance(runner, _SelfCheckRunner):
+            return {
+                "plan_mode": runner.plan_mode,
+                "pinned_ops": runner.pinned_ops,
+                "plan_state": runner.mode,
+            }
+        if plan.fn is not None:
+            mode = "segmented"
+        elif plan.use_jit:
+            mode = "whole-graph"
+        else:
+            mode = "eager"
+        return {"plan_mode": mode, "pinned_ops": [], "plan_state": "static"}
 
     def evaluate(
         self,
@@ -774,7 +1266,7 @@ class Interpreter:
                 if selfcheck:
                     runner = _SelfCheckRunner(
                         comp, arguments, _selfcheck_runs(),
-                        dialect=self._dialect,
+                        dialect=self._dialect, plan_key=self._plan_key,
                     )
                     plan, fn = runner.eager_plan, runner.run
                 else:
@@ -817,8 +1309,14 @@ class Interpreter:
         master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
         # the span covers output materialization as well — jit dispatch is
         # async, so timing the call alone would under-measure
-        with telemetry.span("execute", jit=plan.use_jit):
+        with telemetry.span("execute", jit=plan.use_jit) as sp:
             outputs, saves = fn(master_key, dyn)
+            # plan shape AFTER the run: a validating evaluation may have
+            # promoted/demoted/pinned during the call
+            info = self._plan_info(plan, fn)
+            self.last_plan_info = info
+            sp.attrs["plan_mode"] = info["plan_mode"]
+            sp.attrs["pinned_ops"] = len(info["pinned_ops"])
             for (plc_name, key), value in saves.items():
                 storage.setdefault(plc_name, {})[key] = _to_user_value(value)
             return {

@@ -286,14 +286,17 @@ def _recv_sources(comp: Computation, order) -> dict:
 
 
 def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
-                      keys, dyn, recv_src, trace_ops=False):
+                      keys, dyn, recv_src, trace_ops=False,
+                      fault_kinds=frozenset()):
     """Execute host-level ops in order against ``env`` — shared by the
-    whole-graph core and the per-segment cores."""
+    whole-graph core and the per-segment cores.  ``fault_kinds``
+    (self-check jit candidates only) injects a synthetic divergence into
+    ops of the listed kinds — see ``interpreter._fault_kinds``."""
     import jax
     import jax.numpy as jnp
 
     from .. import telemetry
-    from .interpreter import _lift_array
+    from .interpreter import _fault_perturb, _lift_array
 
     for n in names:
         op = comp.operations[n]
@@ -349,10 +352,13 @@ def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
                 )
         else:
             env[n] = execute_kernel(sess, op, plc, args)
+        if fault_kinds and op.kind in fault_kinds:
+            env[n] = _fault_perturb(env[n])
 
 
 def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
-                segment_limit=None, jit_segments: bool = True):
+                segment_limit=None, jit_segments: bool = True,
+                fault_kinds=frozenset()):
     """Build (and jit) the execution closure for one (computation,
     binding) pair; cached by PhysicalInterpreter across calls."""
     import jax
@@ -396,7 +402,7 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
     if use_jit and len(order) > limit:
         fn = _build_segmented_physical(
             comp_ref, order, static_env, dyn_names, key_ops, recv_src,
-            limit, jit_segments,
+            limit, jit_segments, fault_kinds,
         )
         return order, key_ops, dyn_names, static_env, fn
 
@@ -410,7 +416,7 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
         saves: dict[tuple, Any] = {}
         _run_physical_ops(
             sess, comp, order, static_env, env, outputs, saves, keys,
-            dyn, recv_src, trace_ops,
+            dyn, recv_src, trace_ops, fault_kinds,
         )
         return outputs, saves
 
@@ -420,7 +426,8 @@ def _build_plan(comp: Computation, arguments: dict, use_jit: bool,
 
 def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
                               key_ops, recv_src, limit=None,
-                              jit_segments: bool = True):
+                              jit_segments: bool = True,
+                              fault_kinds=frozenset()):
     """Lowered-graph segmentation over the SHARED orchestrator
     (interpreter.build_segmented_runner).  Receive ops read their Send's
     input through ``recv_src``, so cross-segment transfers are ordinary
@@ -444,7 +451,7 @@ def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
         sess = EagerSession()
         _run_physical_ops(
             sess, comp, names, static_env, env, outputs, saves,
-            keys, dyn, recv_src,
+            keys, dyn, recv_src, False, fault_kinds,
         )
 
     # per-segment key narrowing needs the chunking; compute it once and
@@ -468,16 +475,73 @@ def _build_segmented_physical(comp_ref, order, static_env, dyn_names,
 
 
 def _physical_plan_builder(comp, arguments, use_jit, segment_limit,
-                           jit_segments):
+                           jit_segments, fault_kinds=frozenset()):
     """builder hook for the shared ``_SelfCheckRunner``: physical plans
     take every PRF key as a runtime input and bake sync keys as graph
     attributes, so eager and jitted execution from the same ``keys``
     dict must be bit-identical (no nonce pinning)."""
     plan = _build_plan(
         comp, arguments, use_jit, segment_limit=segment_limit,
-        jit_segments=jit_segments,
+        jit_segments=jit_segments, fault_kinds=fault_kinds,
     )
     return plan, plan[4]
+
+
+# host-boundary / trivial kinds the per-op rung never jit-wraps: there
+# is nothing to fuse and nothing the miscompile class can touch
+_PER_OP_EAGER_KINDS = frozenset({
+    "Input", "Load", "Save", "Output", "Send", "Receive", "PrfKeyGen",
+    "Constant", "Identity",
+})
+
+
+def _physical_per_op_builder(comp, arguments, eager_plan, fault_kinds,
+                             nonce_seed, pinned=()):
+    """per-op-rung builder hook for lowered plans (the shared
+    ``_SelfCheckRunner``'s ``per_op_builder``): ops take their PRF keys
+    as runtime inputs — no nonce pinning needed — and each Receive reads
+    its Send's input as an ordinary dataflow edge, so per-op programs
+    compose exactly like segments do."""
+    import weakref
+
+    from .interpreter import _per_op_limit, _PerOpPlan
+
+    order, key_ops, dyn_names, static_env, _ = eager_plan
+    if len(order) > _per_op_limit():
+        return None
+    comp_ref = weakref.ref(comp)
+    recv_src = _recv_sources(comp, order)
+    key_set = set(key_ops)
+
+    def effective_inputs(n):
+        op = comp.operations[n]
+        if op.kind == "Receive":
+            return [recv_src[op.name]]
+        return op.inputs
+
+    def seg_exec(si, names, keys, dyn, env, outputs, saves,
+                 fault=frozenset()):
+        comp = comp_ref()
+        if comp is None:  # pragma: no cover - defensive
+            raise KernelError("computation was garbage-collected")
+        sess = EagerSession()
+        _run_physical_ops(
+            sess, comp, names, static_env, env, outputs, saves,
+            keys, dyn, recv_src, False, fault,
+        )
+
+    always = {
+        n for n in order
+        if comp.operations[n].kind in _PER_OP_EAGER_KINDS
+    }
+    return _PerOpPlan(
+        order, static_env, dyn_names, effective_inputs, seg_exec,
+        fault_kinds,
+        lambda keys, si: (
+            {order[si]: keys[order[si]]} if order[si] in key_set else {}
+        ),
+        always_eager=always, pinned=pinned,
+    )
 
 
 class PhysicalInterpreter:
@@ -488,6 +552,27 @@ class PhysicalInterpreter:
         import weakref
 
         self._cache = weakref.WeakKeyDictionary()
+        # resolved plan shape of the most recent evaluate() — the
+        # runtime lifts this into last_timings/last_plan
+        self.last_plan_info: dict = {}
+
+    def _plan_info(self, comp, use_jit, fn) -> dict:
+        from .interpreter import _segment_limit, _SelfCheckRunner
+
+        runner = getattr(fn, "__self__", None)
+        if isinstance(runner, _SelfCheckRunner):
+            return {
+                "plan_mode": runner.plan_mode,
+                "pinned_ops": runner.pinned_ops,
+                "plan_state": runner.mode,
+            }
+        if not use_jit:
+            mode = "eager"
+        elif len(comp.operations) > _segment_limit():
+            mode = "segmented"
+        else:
+            mode = "whole-graph"
+        return {"plan_mode": mode, "pinned_ops": [], "plan_state": "static"}
 
     def evaluate(
         self,
@@ -516,6 +601,8 @@ class PhysicalInterpreter:
                 runner = _SelfCheckRunner(
                     comp, arguments, _selfcheck_runs(),
                     builder=_physical_plan_builder, pin_nonces=False,
+                    per_op_builder=_physical_per_op_builder,
+                    plan_key="physical",
                 )
                 order, key_ops, dyn_names, static_env, _ = runner.eager_plan
                 plan = (order, key_ops, dyn_names, static_env, runner.run)
@@ -552,8 +639,17 @@ class PhysicalInterpreter:
                     val = np.asarray(val)
                 dyn[n] = _device_cache.put(val)
 
+        from .. import telemetry
+
         keys = {n: _fresh_key_words() for n in key_ops}
-        outputs, saves = fn(keys, dyn)
+        with telemetry.span("execute", jit=use_jit) as sp:
+            outputs, saves = fn(keys, dyn)
+            # plan shape AFTER the run: a validating evaluation may have
+            # promoted/demoted/pinned during the call
+            info = self._plan_info(comp, use_jit, fn)
+            self.last_plan_info = info
+            sp.attrs["plan_mode"] = info["plan_mode"]
+            sp.attrs["pinned_ops"] = len(info["pinned_ops"])
 
         from .interpreter import _to_user_value, ordered_output_names
 

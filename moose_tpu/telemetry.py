@@ -366,6 +366,32 @@ def _get_exporter() -> Optional[OtlpExporter]:
     return _exporter
 
 
+_MISSING = object()
+
+
+def _find_attr(s: Optional[Span], key: str):
+    if s is None:
+        return _MISSING
+    if key in s.attrs:
+        return s.attrs[key]
+    for child in s.children:
+        value = _find_attr(child, key)
+        if value is not _MISSING:
+            return value
+    return _MISSING
+
+
+def find_attr(root: Optional[Span], key: str, default=None):
+    """Depth-first search of a span tree for the first span carrying
+    attribute ``key``; returns that attribute's value.  Runtimes use
+    this to lift executor-level plan attributes (``plan_mode``,
+    ``pinned_ops`` — set on the ``execute`` span by both local
+    interpreters) into ``last_timings`` without coupling to which
+    executor actually ran."""
+    value = _find_attr(root, key)
+    return default if value is _MISSING else value
+
+
 def phase_timings(root: Optional[Span] = None) -> Dict[str, int]:
     """Flatten a span tree into a {name: duration_micros} map — the Local
     analogue of the reference's per-role elapsed-time map.  Durations of

@@ -38,7 +38,7 @@ import sys
 import numpy as np
 
 
-def build_lowered_softmax(arguments):
+def build_lowered_softmax(arguments, classes=4, precision=(24, 40)):
     import moose_tpu as pm
     from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
     from moose_tpu.compilation.lowering import arg_specs_from_arguments
@@ -52,9 +52,9 @@ def build_lowered_softmax(arguments):
     @pm.computation
     def comp(x: pm.Argument(placement=alice, dtype=pm.float64)):
         with alice:
-            xf = pm.cast(x, dtype=pm.fixed(24, 40))
+            xf = pm.cast(x, dtype=pm.fixed(*precision))
         with rep:
-            y = pm.softmax(xf, axis=1, upmost_index=4)
+            y = pm.softmax(xf, axis=1, upmost_index=classes)
         with carole:
             out = pm.cast(y, dtype=pm.float64)
         return out
@@ -81,7 +81,14 @@ def main():
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu) before init")
     parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--classes", type=int, default=4,
+                        help="softmax width (fewer classes = smaller "
+                        "graph; CI uses 2 as a reduced regression guard)")
+    parser.add_argument("--precision", default="24,40",
+                        help="fixed-point 'i,f' — e.g. 8,17 selects the "
+                        "64-bit ring for a much smaller lowered graph")
     args = parser.parse_args()
+    integ, frac = (int(p) for p in args.precision.split(","))
 
     import moose_tpu  # noqa: F401  (x64 + plugin setup)
     import jax
@@ -94,9 +101,11 @@ def main():
     from moose_tpu.execution.interpreter import plan_segments
 
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(args.batch, 4)) * 2.0
+    x = rng.normal(size=(args.batch, args.classes)) * 2.0
     arguments = {"x": x}
-    comp = build_lowered_softmax(arguments)
+    comp = build_lowered_softmax(
+        arguments, classes=args.classes, precision=(integ, frac)
+    )
 
     plan = physical._build_plan(comp, arguments, False)
     order, key_ops, dyn_names, static_env, _ = plan

@@ -273,3 +273,251 @@ def test_physical_selfcheck_demotes_on_corruption():
     np.testing.assert_allclose(np.asarray(val), want, atol=1e-5)
     assert runner.mode == "validating"
     assert runner._level == 1
+
+
+# ---------------------------------------------------------------------------
+# Per-op rung: after the 50-op rung fails, every op becomes its own
+# validated XLA program and only the divergent ops are pinned eager.
+# The MOOSE_TPU_SELFCHECK_FAULT hook injects the divergence (the real
+# miscompile cannot reproduce on CPU).
+# ---------------------------------------------------------------------------
+
+
+def _mul_add_comp():
+    """One Mul (the faulted op) plus one Add on the replicated
+    placement — small protocol circuits so the ladder's repeated
+    whole-graph compiles stay cheap on CPU."""
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(8, 17))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(8, 17))
+        with rep:
+            y = pm.add(pm.mul(xf, wf), xf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    return tracer.trace(comp)
+
+
+def _drive_to_steady_state(runner, dyn, key_fn, max_runs=12):
+    outs = []
+    for i in range(max_runs):
+        if runner.mode != "validating":
+            break
+        out, _ = runner.run(key_fn(i), dyn)
+        outs.append(out)
+    return outs
+
+
+def test_per_op_rung_pins_exactly_the_faulted_op(monkeypatch):
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FAULT", "Mul")
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 3)) * 0.5
+    w = rng.normal(size=(4, 3)) * 0.5
+    args = {"x": x, "w": w}
+    want = x * w + x
+    comp = _mul_add_comp()
+    mul_ops = sorted(
+        n for n, op in comp.operations.items() if op.kind == "Mul"
+    )
+    assert len(mul_ops) == 1
+
+    runner = interp._SelfCheckRunner(comp, args, checks=1)
+    dyn = _dyn(runner, args)
+    outs = _drive_to_steady_state(runner, dyn, lambda i: _mk(40 + i))
+
+    # the whole-graph, 200-op and 50-op rungs all carry the injected
+    # fault, so the ladder must land on the per-op rung with EXACTLY
+    # the faulted op pinned eager and everything else (the Add included)
+    # jitted
+    assert runner.mode == "per-op"
+    assert runner.plan_mode == "per-op"
+    assert runner.pinned_ops == mul_ops
+
+    out, _ = runner.run(_mk(99), dyn)  # steady-state mixed execution
+    for o in outs + [out]:
+        np.testing.assert_allclose(_decode_outputs(o), want, atol=5e-3)
+
+    # the resolved plan is registered weak-keyed on the computation:
+    # a NEW runner (fresh runtime/binding) restores the promotion and
+    # the pinned set instead of re-diverging through the ladder
+    runner2 = interp._SelfCheckRunner(comp, args, checks=1)
+    assert runner2.mode == "per-op"
+    assert runner2.pinned_ops == mul_ops
+    out2, _ = runner2.run(_mk(120), _dyn(runner2, args))
+    np.testing.assert_allclose(_decode_outputs(out2), want, atol=5e-3)
+
+
+def _lowered_mul_setup():
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+
+    rng = np.random.default_rng(44)
+    x = rng.normal(size=(3, 2))
+    w = rng.normal(size=(3, 2))
+    args = {"x": x, "w": w}
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(8, 17))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(8, 17))
+        with rep:
+            y = pm.mul(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    passes = [p for p in DEFAULT_PASSES if p != "networking"]
+    lowered = compile_computation(
+        tracer.trace(comp), passes,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    return lowered, args, x * w
+
+
+def test_physical_per_op_rung_is_bit_exact_with_pinned_op(monkeypatch):
+    """Acceptance: under injected single-op divergence exactly one op is
+    pinned eager and end-to-end outputs stay bit-exact vs the all-eager
+    reference (physical plans are fully deterministic given keys)."""
+    from moose_tpu.execution import physical
+
+    comp, args, want = _lowered_mul_setup()
+    neg_ops = sorted(
+        n for n, op in comp.operations.items() if op.kind == "Neg"
+    )
+    assert len(neg_ops) == 1  # the faulted kind appears exactly once
+
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FAULT", "Neg")
+    runner = interp._SelfCheckRunner(
+        comp, args, checks=1,
+        builder=physical._physical_plan_builder, pin_nonces=False,
+        per_op_builder=physical._physical_per_op_builder,
+        plan_key="physical",
+    )
+    order, key_ops, dyn_names, static_env, _ = runner.eager_plan
+    dyn = {n: np.asarray(args[n]) for n in dyn_names}
+
+    def keys(i):
+        return {
+            n: np.arange(4, dtype=np.uint32) + 50 + i for n in key_ops
+        }
+
+    _drive_to_steady_state(runner, dyn, keys)
+    assert runner.mode == "per-op"
+    assert runner.pinned_ops == neg_ops
+
+    # bit-exactness: the mixed per-op plan from keys K must equal the
+    # whole-graph all-eager reference from the SAME K, bit for bit
+    k = keys(99)
+    mixed = runner.run(k, dyn)
+    ref = runner._eager_fn(k, dyn)
+    assert interp._results_equal(mixed, ref)
+    (val,) = [interp._to_user_value(v) for v in ref[0].values()]
+    np.testing.assert_allclose(np.asarray(val), want, atol=1e-4)
+
+
+def test_small_graph_promotes_to_segmented_via_runtime(monkeypatch):
+    """The validated-jit path promotes a clean (fault-free) lowered
+    graph to segmented jit and the runtime surfaces `plan_mode` —
+    cheap companion of the >2000-op acceptance test below."""
+    from moose_tpu.runtime import LocalMooseRuntime
+
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FORCE", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SEGMENT", "50")
+    comp, args, want = _lowered_mul_setup()  # 123 ops -> 3 segments
+    rt = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
+    for _ in range(3):  # 2 validating runs (K=2 default) + 1 jitted
+        (got,) = rt.evaluate_computation(comp, arguments=args).values()
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+    assert rt.last_timings["plan_mode"] == "segmented"
+    assert rt.last_plan.get("plan_state") == "jit"
+    assert rt.last_timings["pinned_ops"] == []
+
+
+@pytest.mark.slow
+def test_big_lowered_graph_promotes_to_segmented_on_cpu(monkeypatch):
+    """Acceptance: on CPU (no miscompile), a >2000-op lowered protocol
+    graph promotes past the self-check to segmented jit and `plan_mode`
+    reports it."""
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.runtime import LocalMooseRuntime
+
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FORCE", "1")
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(4, 3)) * 0.5
+    w = rng.normal(size=(3, 1)) * 0.5
+    args = {"x": x, "w": w}
+    want = 1.0 / (1.0 + np.exp(-(x @ w)))
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(8, 17))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(8, 17))
+        with rep:
+            y = pm.sigmoid(pm.dot(xf, wf))
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    passes = [p for p in DEFAULT_PASSES if p != "networking"]
+    lowered = compile_computation(
+        tracer.trace(comp), passes,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    assert len(lowered.operations) > 2000
+
+    rt = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
+    for _ in range(3):  # 2 validating runs (K=2 default) + 1 jitted
+        (got,) = rt.evaluate_computation(lowered, arguments=args).values()
+        np.testing.assert_allclose(np.asarray(got), want, atol=5e-3)
+    assert rt.last_timings["plan_mode"] == "segmented"
+    assert rt.last_plan.get("plan_state") == "jit"
+    assert rt.last_timings["pinned_ops"] == []
+
+
+def test_per_op_limit_skips_rung_to_eager(monkeypatch):
+    """Plans above MOOSE_TPU_PEROP_MAX skip the per-op rung: exhausting
+    the segment rungs pins eager (and flags `exhausted` for the
+    runtime's cross-layout reroute)."""
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FAULT", "Mul")
+    monkeypatch.setenv("MOOSE_TPU_PEROP_MAX", "2")
+    rng = np.random.default_rng(6)
+    args = {"x": rng.normal(size=(2, 2)), "w": rng.normal(size=(2, 2))}
+    comp = _mul_add_comp()
+    runner = interp._SelfCheckRunner(comp, args, checks=1)
+    dyn = _dyn(runner, args)
+    _drive_to_steady_state(runner, dyn, lambda i: _mk(70 + i))
+    assert runner.mode == "eager"
+    assert runner.exhausted

@@ -312,3 +312,305 @@ def test_unsupported_graph_falls_back_to_per_host():
     np.testing.assert_allclose(
         np.asarray(got), [1.0, 9.0], atol=1e-3
     )  # executed via the per-host fallback
+
+
+# ---------------------------------------------------------------------------
+# Cross-layout demotion routing + per-op ladder surfacing (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+
+def _linear_comp():
+    alice, bob, carole, rep = _players()
+    fx_dtype = pm.fixed(14, 23)
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        y: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            x_f = pm.cast(x, dtype=fx_dtype)
+        with bob:
+            y_f = pm.cast(y, dtype=fx_dtype)
+        with rep:
+            z = pm.add(x_f, pm.sub(x_f, y_f))
+        with carole:
+            out = pm.cast(z, dtype=pm.float64)
+        return out
+
+    return comp
+
+
+def test_stacked_ladder_exhaustion_reroutes_to_per_host(monkeypatch):
+    """Acceptance: LocalMooseRuntime(layout='stacked') never settles on
+    a plan slower than the per-host route — ladder exhaustion reroutes
+    instead of pinning stacked-eager, preserving outputs bit-for-bit
+    (the linear graph is exact, so the layouts agree exactly)."""
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FORCE", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    comp = _linear_comp()
+    rng = np.random.default_rng(9)
+    args = {"x": rng.normal(size=(8, 3)), "y": rng.normal(size=(8, 3))}
+
+    rt = LocalMooseRuntime(
+        ["alice", "bob", "carole"], layout="stacked", use_jit=True
+    )
+    (got1,) = rt.evaluate_computation(comp, arguments=args).values()
+    assert rt.last_plan.get("layout") == "stacked"
+
+    # force ladder exhaustion on the cached stacked runner (the real
+    # miscompile cannot reproduce on CPU)
+    from moose_tpu.execution import interpreter as interp
+
+    traced = rt._trace_cache[comp]
+    ((_, fn),) = rt._stacked._cache[traced].values()
+    runner = fn.__self__
+    assert isinstance(runner, interp._SelfCheckRunner)
+    runner.mode = "eager"
+    runner._save_state()
+    assert rt._stacked.plan_exhausted(traced, args)
+
+    (got2,) = rt.evaluate_computation(comp, arguments=args).values()
+    assert rt.last_plan.get("layout") == "per-host"  # rerouted
+    assert rt.last_timings.get("plan_mode") is not None
+    np.testing.assert_array_equal(np.asarray(got1), np.asarray(got2))
+
+
+def test_stacked_userpath_per_op_plan_mode_via_runtime(monkeypatch):
+    """The full user path under a single divergent op: the runtime
+    surfaces the resolved per-op plan (`plan_mode`, pinned op names)
+    through last_timings/last_plan, and results stay correct at every
+    ladder stage."""
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FORCE", "1")
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FAULT", "Mul")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(8, 17))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(8, 17))
+        with rep:
+            y = pm.add(pm.mul(xf, wf), xf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 3)) * 0.5
+    w = rng.normal(size=(4, 3)) * 0.5
+    args = {"x": x, "w": w}
+    want = x * w + x
+
+    rt = LocalMooseRuntime(
+        ["alice", "bob", "carole"], layout="stacked", use_jit=True
+    )
+    for _ in range(8):
+        (got,) = rt.evaluate_computation(comp, arguments=args).values()
+        np.testing.assert_allclose(np.asarray(got), want, atol=5e-3)
+        if rt.last_plan.get("plan_state") == "per-op":
+            break
+    assert rt.last_timings["plan_mode"] == "per-op"
+    traced = rt._trace_cache[comp]
+    pinned = rt.last_timings["pinned_ops"]
+    assert [traced.operations[n].kind for n in pinned] == ["Mul"]
+    assert rt.last_plan.get("layout") == "stacked"
+
+
+def test_stacked_runtime_falls_back_on_typed_rejection():
+    """A typed TypeMismatchError out of the stacked dialect (value shape
+    supports() could not see) falls back to the per-host path instead of
+    failing the evaluation, and later calls skip the stacked attempt."""
+    from moose_tpu.errors import TypeMismatchError
+
+    comp = _logreg_comp(pm.fixed(14, 23))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)) * 0.5
+    w = rng.normal(size=(4, 1)) * 0.5
+    args = {"x": x, "w": w}
+    want = 1.0 / (1.0 + np.exp(-(x @ w)))
+
+    rt = LocalMooseRuntime(["alice", "bob", "carole"], layout="stacked")
+
+    def boom(*a, **k):
+        raise TypeMismatchError("injected dispatch rejection")
+
+    rt._stacked._dialect.execute_op = boom
+    (got,) = rt.evaluate_computation(comp, arguments=args).values()
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-3)
+    assert rt.last_plan.get("layout") == "per-host"
+    traced = rt._trace_cache[comp]
+    assert traced in rt._stacked_rejected
+    # second call routes straight to per-host without re-raising
+    (got2,) = rt.evaluate_computation(comp, arguments=args).values()
+    np.testing.assert_allclose(np.asarray(got2), want, atol=1e-3)
+
+
+def test_to_rep_integer_lift_width_follows_signature():
+    """ADVICE r5 low #1: secret integer lifts pick their ring from the
+    consuming op's signature instead of hard-coded 64."""
+    import importlib
+
+    C = importlib.import_module("moose_tpu.computation")
+    from moose_tpu import dtypes as dt
+    from moose_tpu.dialects import stacked as stacked_dialect
+    from moose_tpu.values import HostTensor
+
+    sess = stacked_dialect.StackedSession(
+        np.arange(4, dtype=np.uint32) + 3
+    )
+    v = HostTensor(np.arange(6, dtype=np.uint64).reshape(2, 3),
+                   "alice", dt.uint64)
+    assert stacked_dialect.to_rep(sess, v).width == 64  # native default
+    assert stacked_dialect.to_rep(sess, v, width=128).width == 128
+
+    # the width derives from the op signature: fixed128 inputs/returns
+    # force a 128-bit lift, fixed64 a 64-bit one
+    op128 = C.Operation(
+        name="c", kind="Cast", inputs=["a"], placement_name="rep",
+        signature=C.signature(
+            [C.tensor_ty(dt.uint64)], C.tensor_ty(dt.fixed128(14, 23))
+        ),
+    )
+    assert stacked_dialect._op_ring_width(op128) == 128
+    op64 = C.Operation(
+        name="c", kind="Cast", inputs=["a"], placement_name="rep",
+        signature=C.signature(
+            [C.tensor_ty(dt.uint64)], C.tensor_ty(dt.fixed64(8, 17))
+        ),
+    )
+    assert stacked_dialect._op_ring_width(op64) == 64
+
+    # float tensors still cannot be shared — but now with a TYPED error
+    from moose_tpu.errors import TypeMismatchError
+
+    fv = HostTensor(np.ones((2, 2)), "alice", dt.float64)
+    with pytest.raises(TypeMismatchError):
+        stacked_dialect.to_rep(sess, fv)
+
+
+def test_stacked_cast_int_to_fixed_lifts_at_target_ring():
+    """Replicated Cast of a secret integer to a fixed dtype lifts at the
+    TARGET ring (the ADVICE r5 low #1 scenario made workable), and a
+    sharing already produced at another width is rejected with a typed
+    error instead of silently relabelled."""
+    import importlib
+
+    C = importlib.import_module("moose_tpu.computation")
+    from moose_tpu import dtypes as dt
+    from moose_tpu.dialects import stacked as stacked_dialect
+    from moose_tpu.errors import TypeMismatchError
+    from moose_tpu.values import HostTensor
+
+    sess = stacked_dialect.StackedSession(
+        np.arange(4, dtype=np.uint32) + 11
+    )
+    rep = C.ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+    comp = C.Computation()
+    fx128 = dt.fixed128(14, 23)
+    op = C.Operation(
+        name="c", kind="Cast", inputs=["a"], placement_name="rep",
+        signature=C.signature(
+            [C.tensor_ty(dt.uint64)], C.tensor_ty(fx128)
+        ),
+    )
+    ints = np.array([[1, 2], [3, 40]], dtype=np.uint64)
+    v = HostTensor(ints, "alice", dt.uint64)
+    out = stacked_dialect._execute_rep(sess, comp, op, rep, [v])
+    assert out.tensor.width == 128  # lifted at the target ring
+    host = stacked_dialect.to_host(sess, "alice", out)
+    from moose_tpu.dialects import host as host_ops
+
+    decoded = np.asarray(
+        host_ops.fixedpoint_decode(host, "alice").value
+    )
+    np.testing.assert_allclose(decoded, ints.astype(np.float64))
+
+    # a sharing already at ring64 cannot be relabelled as fixed128
+    r64 = stacked_dialect.to_rep(sess, v, width=64)
+    with pytest.raises(TypeMismatchError):
+        stacked_dialect._execute_rep(sess, comp, op, rep, [r64])
+
+
+def test_supports_screens_dispatch_rejections():
+    """ADVICE r5 low #2: graphs _execute_rep/to_rep would reject at
+    dispatch time (float constants on replicated placements, non-fixed
+    Cast targets, mixed secret integer/fixed arithmetic) are screened
+    out by supports() so the runtime falls back up front."""
+    import importlib
+
+    C = importlib.import_module("moose_tpu.computation")
+    from moose_tpu import dtypes as dt
+    from moose_tpu.dialects import stacked as stacked_dialect
+
+    def base_comp():
+        comp = C.Computation()
+        comp.add_placement(C.HostPlacement("alice"))
+        comp.add_placement(C.HostPlacement("bob"))
+        comp.add_placement(C.HostPlacement("carole"))
+        comp.add_placement(
+            C.ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+        )
+        return comp
+
+    f64 = C.tensor_ty(dt.float64)
+    fx = C.tensor_ty(dt.fixed128(14, 23))
+    u64 = C.tensor_ty(dt.uint64)
+
+    # float Constant on the replicated placement: to_rep cannot share it
+    comp = base_comp()
+    comp.add_operation(C.Operation(
+        name="c", kind="Constant", inputs=[], placement_name="rep",
+        signature=C.signature([], f64),
+        attributes={"value": np.ones((2, 2))},
+    ))
+    assert not stacked_dialect.supports(comp)
+
+    # Cast to a non-fixed dtype on the replicated placement
+    comp = base_comp()
+    comp.add_operation(C.Operation(
+        name="x", kind="Input", inputs=[], placement_name="alice",
+        signature=C.signature([], fx),
+    ))
+    comp.add_operation(C.Operation(
+        name="c", kind="Cast", inputs=["x"], placement_name="rep",
+        signature=C.signature([fx], f64),
+    ))
+    assert not stacked_dialect.supports(comp)
+
+    # mixed secret integer / fixed arithmetic has no stacked kernel
+    comp = base_comp()
+    comp.add_operation(C.Operation(
+        name="a", kind="Input", inputs=[], placement_name="alice",
+        signature=C.signature([], u64),
+    ))
+    comp.add_operation(C.Operation(
+        name="b", kind="Input", inputs=[], placement_name="bob",
+        signature=C.signature([], fx),
+    ))
+    comp.add_operation(C.Operation(
+        name="m", kind="Mul", inputs=["a", "b"], placement_name="rep",
+        signature=C.signature([u64, fx], fx),
+    ))
+    assert not stacked_dialect.supports(comp)
+
+    # ...while the all-fixed equivalent stays supported
+    comp = base_comp()
+    comp.add_operation(C.Operation(
+        name="a", kind="Input", inputs=[], placement_name="alice",
+        signature=C.signature([], fx),
+    ))
+    comp.add_operation(C.Operation(
+        name="b", kind="Input", inputs=[], placement_name="bob",
+        signature=C.signature([], fx),
+    ))
+    comp.add_operation(C.Operation(
+        name="m", kind="Mul", inputs=["a", "b"], placement_name="rep",
+        signature=C.signature([fx, fx], fx),
+    ))
+    assert stacked_dialect.supports(comp)

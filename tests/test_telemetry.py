@@ -31,6 +31,41 @@ def test_span_nesting_and_timings():
     assert len(blob["children"]) == 2
 
 
+def test_find_attr_searches_span_tree():
+    with telemetry.span("outer") as outer:
+        with telemetry.span("mid"):
+            with telemetry.span("execute", plan_mode="per-op",
+                                pinned_ops=1):
+                pass
+    assert telemetry.find_attr(outer, "plan_mode") == "per-op"
+    assert telemetry.find_attr(outer, "pinned_ops") == 1
+    assert telemetry.find_attr(outer, "absent", "dflt") == "dflt"
+    assert telemetry.find_attr(None, "plan_mode", 7) == 7
+
+
+def test_runtime_surfaces_plan_mode():
+    """Resolved plan shape rides along with the phase timings: the
+    execute span's plan attributes are lifted into last_timings and
+    last_plan (ISSUE 2 tentpole c)."""
+    alice = pm.host_placement("alice")
+
+    @pm.computation
+    def comp(x: pm.Argument(placement=alice, vtype=pm.TensorType(pm.float64))):
+        with alice:
+            y = pm.add(x, x)
+        return y
+
+    runtime = LocalMooseRuntime(["alice"], use_jit=False)
+    runtime.evaluate_computation(comp, arguments={"x": np.ones((4,))})
+    assert runtime.last_timings["plan_mode"] == "eager"
+    assert runtime.last_timings["pinned_ops"] == []
+    assert runtime.last_plan["layout"] == "per-host"
+
+    jit_rt = LocalMooseRuntime(["alice"], use_jit=True)
+    jit_rt.evaluate_computation(comp, arguments={"x": np.ones((4,))})
+    assert jit_rt.last_timings["plan_mode"] == "whole-graph"
+
+
 def test_runtime_records_phase_timings():
     alice = pm.host_placement("alice")
 
