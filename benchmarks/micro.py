@@ -151,7 +151,13 @@ def bench_networking_inmem(reps=200):
     small = np.ones((8,))
     big = np.random.default_rng(1).random(1 << 20)  # 8 MB
 
-    def roundtrip(value, key):
+    # sessions never reuse a rendezvous key (the cell store DROPS a
+    # duplicate delivery of a consumed key), so each rep gets a fresh
+    # key — exactly what a real session's per-edge keys look like
+    seq = iter(range(10_000_000))
+
+    def roundtrip(value, prefix):
+        key = f"{prefix}-{next(seq)}"
         net.send(value, "bob", key, "bench-sess")
         return net.receive("alice", key, "bench-sess", "bob", timeout=5.0)
 

@@ -63,6 +63,14 @@ def main(argv=None):
         "collector port, like the reference's comet --telemetry "
         "(comet.rs:30-41)",
     )
+    parser.add_argument(
+        "--receive-timeout", type=float, default=None,
+        help="seconds a blocked receive tolerates zero session progress "
+        "before failing retryably (default: MOOSE_TPU_RECEIVE_TIMEOUT "
+        "or 120).  MOOSE_TPU_CHAOS in the environment additionally arms "
+        "the deterministic fault-injection layer — see DEVELOP.md "
+        "'Failure model'",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -97,7 +105,13 @@ def main(argv=None):
     server = WorkerServer(
         args.identity, args.port, parse_endpoints(args.endpoints),
         storage=storage, tls=tls, choreographer=args.choreographer,
+        receive_timeout=args.receive_timeout,
     ).start()
+    if server.chaos is not None:
+        logging.getLogger("comet").warning(
+            "chaos layer ARMED (MOOSE_TPU_CHAOS): deterministic fault "
+            "injection is active on this worker"
+        )
     logging.getLogger("comet").info(
         "worker %s listening on port %d", args.identity, server.port
     )

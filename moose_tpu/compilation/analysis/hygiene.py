@@ -15,6 +15,10 @@ Rules:
   with side effects or fresh randomness (Input/Output/Load/Save,
   Send/Receive, Sample, PrfKeyGen) are exempt: merging those changes
   semantics.
+- ``MSA403`` (error): duplicate Output tag — two Output ops share one
+  results-dict key, so the later one silently overwrites the earlier
+  one's entry in every executor.  ``well_formed_check`` rejects this
+  fail-fast; the lint reports every collision in one pass.
 """
 
 from __future__ import annotations
@@ -83,6 +87,21 @@ def analyze_hygiene(comp: Computation) -> list[Diagnostic]:
                     op=name, placement=op.placement_name,
                 ))
 
+    output_tags: dict[str, str] = {}
+    for name, op in comp.operations.items():
+        if op.kind != "Output":
+            continue
+        tag = op.attributes.get("tag", name)
+        first = output_tags.setdefault(tag, name)
+        if first != name:
+            diagnostics.append(Diagnostic(
+                "MSA403", Severity.ERROR,
+                f"duplicate Output tag {tag!r} (also on {first!r}): "
+                "the later op silently overwrites the earlier one's "
+                "results entry",
+                op=name, placement=op.placement_name,
+            ))
+
     seen: dict[tuple, str] = {}
     for name, op in comp.operations.items():
         if op.kind in _CSE_EXEMPT_KINDS:
@@ -107,4 +126,5 @@ def analyze_hygiene(comp: Computation) -> list[Diagnostic]:
 RULES = {
     "MSA401": "dead op: unreachable from any Output/Save/Send root",
     "MSA402": "CSE candidate: structurally identical duplicate op",
+    "MSA403": "duplicate Output tag: results dict entries overwrite",
 }

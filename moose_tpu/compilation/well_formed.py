@@ -29,6 +29,11 @@ def rendezvous_attr_problems(op: Operation, placements: dict) -> list[str]:
 
 
 def well_formed_check(comp: Computation) -> Computation:
+    # Output tags key the results dict in every executor (interpreter,
+    # physical, distributed worker — reference
+    # execution/asynchronous.rs:623); two Outputs sharing one tag would
+    # silently overwrite each other's entry
+    output_tags: dict[str, str] = {}
     for name, op in comp.operations.items():
         if op.name != name:
             raise MalformedComputationError(
@@ -66,6 +71,15 @@ def well_formed_check(comp: Computation) -> Computation:
             if problems:
                 raise MalformedComputationError(
                     f"op {name}: {problems[0]}"
+                )
+        if op.kind == "Output":
+            tag = op.attributes.get("tag", name)
+            other = output_tags.setdefault(tag, name)
+            if other != name:
+                raise MalformedComputationError(
+                    f"op {name}: duplicate Output tag {tag!r} (also on "
+                    f"{other!r}); the later op would silently overwrite "
+                    "the earlier one's results entry"
                 )
     # cycle check (toposort raises ValueError; re-raise in the
     # compilation error taxonomy)

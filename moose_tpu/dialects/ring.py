@@ -394,6 +394,18 @@ def sample_uniform_seeded(shape, seed_u32x4, width: int):
     return both[1], both[0]
 
 
+def _bit_domain_seed(seed_u32x4):
+    """Domain-separation tag for BIT draws: flip a high key bit so a
+    seed reused across a uniform draw (:func:`sample_uniform_seeded`)
+    and a bit draw can never index the same PRF counter stream.
+    Applied uniformly in EVERY backend branch (ADVICE r5: tagging only
+    the pallas branch left the default threefry and aes-ctr backends
+    sharing a stream)."""
+    return jnp.asarray(seed_u32x4, dtype=jnp.uint32) ^ jnp.asarray(
+        [0, 0, 0, 0x80000000], dtype=jnp.uint32
+    )
+
+
 def sample_bits_seeded(shape, seed_u32x4, width: int):
     shape = tuple(int(s) for s in shape)
     if _PRF_IMPL == "threefry-pallas":
@@ -401,13 +413,8 @@ def sample_bits_seeded(shape, seed_u32x4, width: int):
 
         # one u64 word yields 64 output bits — draw ceil(n/64) words and
         # unpack, rather than burning a full cipher word per bit.
-        # Domain-separate from sample_uniform_seeded: flip a high key bit
-        # so a seed reused across a uniform draw and a bit draw can never
-        # yield correlated masks (the streams come from distinct keys).
         n = int(np.prod(shape)) if shape else 1
-        tagged = jnp.asarray(seed_u32x4, dtype=jnp.uint32) ^ jnp.asarray(
-            [0, 0, 0, 0x80000000], dtype=jnp.uint32
-        )
+        tagged = _bit_domain_seed(seed_u32x4)
         words = pallas_prf.random_bits_u64(tagged, (-(-n // 64),))
         shifts = jnp.arange(64, dtype=U64)
         bits = ((words[:, None] >> shifts) & jnp.uint64(1)).reshape(-1)
@@ -417,12 +424,14 @@ def sample_bits_seeded(shape, seed_u32x4, width: int):
     if _PRF_IMPL == "aes-ctr":
         from ..crypto.aes_prng import AesCtrRng
 
-        rng = AesCtrRng(_concrete_seed_bytes(seed_u32x4))
+        rng = AesCtrRng(
+            _concrete_seed_bytes(_bit_domain_seed(seed_u32x4))
+        )
         n = int(np.prod(shape)) if shape else 1
         lo = jnp.asarray(rng.bits(n).reshape(shape).astype(np.uint64))
         hi = jnp.zeros_like(lo) if width == 128 else None
         return lo, hi
-    key = _key_from_seed(seed_u32x4)
+    key = _key_from_seed(_bit_domain_seed(seed_u32x4))
     bits = jax.random.bits(key, shape, dtype=jnp.uint8) & jnp.uint8(1)
     lo = bits.astype(U64)
     hi = jnp.zeros_like(lo) if width == 128 else None

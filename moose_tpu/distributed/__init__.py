@@ -1,3 +1,4 @@
 """Distributed execution: networking backends, role-filtered workers,
-choreography, and the client runtime (reference ``moose/src/networking``,
+choreography, the client session supervisor, and the deterministic
+chaos layer (reference ``moose/src/networking``,
 ``moose/src/choreography``, ``moose/src/execution/grpc.rs``)."""

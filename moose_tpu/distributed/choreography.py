@@ -32,8 +32,10 @@ import msgpack
 
 from ..errors import (
     NetworkingError,
+    PeerUnreachableError,
     SessionAbortedError,
     SessionAlreadyExistsError,
+    to_wire,
 )
 from .networking import GrpcNetworking, _CellStore
 
@@ -56,7 +58,9 @@ def _unpack(data: bytes):
 class _SessionState:
     """Book-keeping for one running session."""
 
-    __slots__ = ("cancel", "peers", "abort_reason", "progress")
+    __slots__ = (
+        "cancel", "peers", "abort_reason", "abort_envelope", "progress",
+    )
 
     def __init__(self, peers):
         from .networking import ProgressClock
@@ -65,8 +69,10 @@ class _SessionState:
         self.peers = list(peers)
         # set when the cancel came from outside (choreographer or peer
         # fanout) so the run thread records the root cause, not a bare
-        # "aborted"
+        # "aborted"; the envelope carries the TYPED root cause so the
+        # client re-raises the real exception class
         self.abort_reason: Optional[str] = None
+        self.abort_envelope: Optional[dict] = None
         # receives extend their deadline while this advances; bumped by
         # local op completions AND successful peer pings, so a party
         # idling while live peers crunch a long pipeline never times out
@@ -82,7 +88,10 @@ class WorkerServer:
                  storage: Optional[dict] = None, tls=None,
                  choreographer: Optional[str] = None,
                  ping_interval: float = 0.5, ping_misses: int = 3,
-                 startup_grace: float = 30.0):
+                 startup_grace: float = 30.0,
+                 receive_timeout: Optional[float] = None,
+                 stall_grace: Optional[float] = None,
+                 chaos=None):
         self.identity = identity
         self.port = port
         self.endpoints = dict(endpoints)
@@ -104,11 +113,46 @@ class WorkerServer:
         self.ping_interval = ping_interval
         self.ping_misses = ping_misses
         self.startup_grace = startup_grace
+        # how long a blocked receive tolerates NO session progress
+        # anywhere (local op completions or peer op advances) before it
+        # fails retryably; env override for whole deployments
+        if receive_timeout is None:
+            import os
+
+            receive_timeout = float(
+                os.environ.get("MOOSE_TPU_RECEIVE_TIMEOUT", "120")
+            )
+        self.receive_timeout = receive_timeout
+        # how long blocked receives tolerate live-but-NOT-advancing
+        # peers beyond the last real op advance: one giant op (a huge
+        # jit compile, a 200k-op segment) may legitimately exceed
+        # receive_timeout with every count frozen, so extension
+        # continues for this bounded budget — unlike the unbounded
+        # liveness extension it replaces, a mutually-blocked cluster
+        # (lost send) still times out at ~stall_grace + receive_timeout
+        self.stall_grace = (
+            2.0 * receive_timeout if stall_grace is None else stall_grace
+        )
         import collections
 
-        self.networking = GrpcNetworking(identity, self.endpoints, tls=tls)
+        # chaos: explicit config, or MOOSE_TPU_CHAOS from the
+        # environment (comet daemons pick the same schedule up without
+        # new flags); None disables.  The transport is WRAPPED so every
+        # send/ping of this worker flows through the fault schedule.
+        from .chaos import ChaosConfig
+
+        self.chaos = chaos if chaos is not None else ChaosConfig.from_env()
+        networking = GrpcNetworking(identity, self.endpoints, tls=tls)
+        if self.chaos is not None:
+            self.chaos.register_kill_hook(identity, self._chaos_kill)
+            networking = self.chaos.wrap(networking, identity)
+        self.networking = networking
         self._sessions: dict = {}  # session id -> _SessionState (running)
         self._aborted: "collections.deque[str]" = collections.deque()
+        # aborted session -> root-cause envelope, served through pings:
+        # a peer that missed the abort fanout adopts the abort WITH its
+        # typed cause instead of a generic retryable SessionAborted
+        self._abort_envelopes: dict = {}
         self._completed: "collections.deque[str]" = collections.deque()
         self._results = _CellStore()
         self._lock = threading.Lock()
@@ -155,6 +199,7 @@ class WorkerServer:
             from .worker import execute_role
 
             fanout_reason = None
+            fanout_envelope = None
             try:
                 # deserialization happens off the rpc thread: a large
                 # lowered graph (an AES decrypt circuit is ~200k ops)
@@ -181,6 +226,7 @@ class WorkerServer:
                     comp, self.identity, self.storage, arguments,
                     self.networking, session_id, cancel=state.cancel,
                     progress=state.progress,
+                    timeout=self.receive_timeout,
                 )
                 payload = _pack({
                     "outputs": {
@@ -189,16 +235,21 @@ class WorkerServer:
                     },
                     "elapsed_time_micros": result["elapsed_time_micros"],
                 })
-            except SessionAbortedError:
+            except SessionAbortedError as e:
                 # someone else's root cause cancelled us; the initiator
                 # already fanned out and (if it was this server) already
                 # put the canonical error cell
                 payload = _pack({
                     "error": state.abort_reason or "aborted",
+                    "envelope": state.abort_envelope
+                    or to_wire(e, self.identity),
                 })
             except Exception as e:  # surfaced on retrieve + fanned out
+                fanout_envelope = to_wire(e, self.identity)
                 fanout_reason = f"{type(e).__name__}: {e}"
-                payload = _pack({"error": fanout_reason})
+                payload = _pack({
+                    "error": fanout_reason, "envelope": fanout_envelope,
+                })
             # an aborted session already has its canonical error result;
             # putting again would either clobber it or recreate a
             # never-consumed cell.  The check and put happen under the
@@ -217,16 +268,19 @@ class WorkerServer:
                         # abort even if the fanout below never lands
                         # (the result cell above keeps the real error
                         # for the retriever)
-                        self._aborted.append(session_id)
-                        while len(self._aborted) > self._MAX_ABORTED:
-                            self._aborted.popleft()
+                        self._remember_aborted_locked(
+                            session_id, fanout_envelope
+                        )
             if fanout_reason is not None:
                 # peers may be unknown if the failure hit before the
                 # graph deserialized — notify every configured endpoint
                 targets = state.peers or [
                     p for p in self.endpoints if p != self.identity
                 ]
-                self._fanout_abort(session_id, fanout_reason, targets)
+                self._fanout_abort(
+                    session_id, fanout_reason, targets,
+                    envelope=fanout_envelope,
+                )
 
         threading.Thread(target=run, daemon=True).start()
         return _pack({"ok": True})
@@ -244,23 +298,38 @@ class WorkerServer:
     # state stays bounded
     _MAX_ABORTED = 4096
 
+    def _remember_aborted_locked(self, session_id: str,
+                                 envelope: Optional[dict]) -> None:
+        """Record an aborted id (+ typed cause for ping adoption);
+        caller holds ``self._lock``."""
+        self._aborted.append(session_id)
+        if envelope is not None:
+            self._abort_envelopes[session_id] = envelope
+        while len(self._aborted) > self._MAX_ABORTED:
+            old = self._aborted.popleft()
+            self._abort_envelopes.pop(old, None)
+
     def _abort(self, request: bytes, context=None) -> bytes:
         self._check_choreographer(context)
         msg = _unpack(request)
         self._abort_local(msg["session_id"], reason="aborted")
         return _pack({"ok": True})
 
-    def _abort_local(self, session_id: str, reason: str) -> None:
+    def _abort_local(self, session_id: str, reason: str,
+                     envelope: Optional[dict] = None) -> None:
         """Shared abort path (choreographer rpc, peer fanout, failure
         detector): cancel a running session, record the canonical error
         cell, remember the id so late launches/sends are dropped.  An
-        already-completed session keeps its real result."""
+        already-completed session keeps its real result.  ``envelope``
+        is the typed root cause (errors.to_wire) when the aborter knows
+        it — a peer's fanned-out failure, a detector trip — so every
+        party's result cell re-raises the REAL class at the client."""
+        if envelope is None:
+            envelope = to_wire(SessionAbortedError(reason), self.identity)
         with self._lock:
             completed = session_id in self._completed
             state = self._sessions.pop(session_id, None)
-            self._aborted.append(session_id)
-            while len(self._aborted) > self._MAX_ABORTED:
-                self._aborted.popleft()
+            self._remember_aborted_locked(session_id, envelope)
             if state is not None:
                 # fail-stop semantics: retrievers of a launched session
                 # unblock with the canonical error.  Unknown ids get no
@@ -268,7 +337,10 @@ class WorkerServer:
                 # a cell would be retained forever), completed ones keep
                 # their real result.
                 state.abort_reason = reason
-                self._results.put(session_id, _pack({"error": reason}))
+                state.abort_envelope = envelope
+                self._results.put(session_id, _pack({
+                    "error": reason, "envelope": envelope,
+                }))
         if state is not None:
             # cooperative cancellation: the execute threads check the
             # event between ops and inside blocked receives
@@ -280,18 +352,27 @@ class WorkerServer:
             # retain undelivered tensors in a long-lived worker
             self.networking.cells.drop_session(session_id)
 
-    def _fanout_abort(self, session_id: str, reason: str, peers) -> None:
+    def _fanout_abort(self, session_id: str, reason: str, peers,
+                      envelope: Optional[dict] = None) -> None:
         """Propagate a root-cause error: abort the session on every peer
         (best effort, parallel, short timeout — a dead peer is precisely
-        the case we're propagating around)."""
+        the case we're propagating around).  The typed envelope rides
+        along so peers' result cells carry the originator's real error
+        class, not a generic 'aborted by'."""
+        from .. import telemetry
+
         msg = f"aborted by {self.identity}: {reason}"
+        reached = [0]
 
         def one(peer):
             # two attempts: a transient failure here would otherwise
             # leave the peer relying on its (slower) failure detector
             for attempt in range(2):
                 try:
-                    self.networking.abort_session(peer, session_id, msg)
+                    self.networking.abort_session(
+                        peer, session_id, msg, envelope=envelope
+                    )
+                    reached[0] += 1
                     return
                 except Exception:  # noqa: BLE001 — peer may be the dead one
                     if attempt == 0:
@@ -299,14 +380,19 @@ class WorkerServer:
 
                         time.sleep(0.2)
 
-        threads = [
-            threading.Thread(target=one, args=(p,), daemon=True)
-            for p in peers
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=5.0)
+        with telemetry.span(
+            "abort_fanout", session_id=session_id, party=self.identity,
+            peers=len(list(peers)), reason=reason,
+        ) as s:
+            threads = [
+                threading.Thread(target=one, args=(p,), daemon=True)
+                for p in peers
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5.0)
+            s.attrs["reached"] = reached[0]
 
     def _abort_session(self, request: bytes, context=None) -> bytes:
         """Participant-level abort (peer fanout target).  Under mTLS the
@@ -328,7 +414,9 @@ class WorkerServer:
                     f"peer certificate CN {peer!r}",
                 )
         self._abort_local(
-            msg["session_id"], reason=msg.get("reason", "aborted by peer")
+            msg["session_id"],
+            reason=msg.get("reason", "aborted by peer"),
+            envelope=msg.get("envelope"),
         )
         return _pack({"ok": True})
 
@@ -336,18 +424,33 @@ class WorkerServer:
         msg = _unpack(request) if request else {}
         session_id = msg.get("session_id")
         status = None
+        ops = None
+        abort_envelope = None
         if session_id is not None:
             with self._lock:
                 if session_id in self._sessions:
                     status = "running"
+                    # op-completion count: progress EVIDENCE, so a
+                    # peer's detector can tell "alive and advancing"
+                    # (extend blocked receives) from "alive but stuck"
+                    # (let the no-progress timeout fire — e.g. after a
+                    # lost send leaves everyone mutually blocked)
+                    ops = self._sessions[session_id].progress.count
                 elif session_id in self._aborted:
                     status = "aborted"
+                    # the typed root cause rides along so an adopter
+                    # that missed the fanout still re-raises the real
+                    # class (and its retryable bit) at the client
+                    abort_envelope = self._abort_envelopes.get(
+                        session_id
+                    )
                 elif session_id in self._completed:
                     status = "completed"
                 else:
                     status = "unknown"
         return _pack({
             "ok": True, "identity": self.identity, "session": status,
+            "ops": ops, "abort_envelope": abort_envelope,
         })
 
     def _failure_detector(self, session_id: str, state: _SessionState):
@@ -369,6 +472,8 @@ class WorkerServer:
         start = time.monotonic()
         misses = {p: 0 for p in state.peers}
         seen = {p: False for p in state.peers}
+        last_ops: dict = {}  # peer -> last reported op count / status
+        last_advance = time.monotonic()
         trip_at = 2 * self.ping_misses
         while True:
             time.sleep(self.ping_interval)
@@ -376,12 +481,17 @@ class WorkerServer:
                 if session_id not in self._sessions:
                     return  # session finished or was aborted
             # progress extends blocked receives only when EVERY peer
-            # shows session liveness this round: a single peer stuck at
+            # shows session liveness this round AND at least one peer
+            # reports real op advances: a single peer stuck at
             # "unknown" (its launch never arrived — e.g. the client died
             # mid-fanout) must let the hard timeout fire even while the
-            # other peers keep answering
+            # other peers keep answering, and a cluster where every
+            # party is mutually blocked (a send was lost on the wire)
+            # must time out rather than extend deadlines off bare
+            # liveness forever
             all_live = True
             all_completed = bool(state.peers)
+            any_advance = False
             for peer in state.peers:
                 if state.cancel.is_set():
                     return
@@ -392,15 +502,32 @@ class WorkerServer:
                     seen[peer] = True
                     misses[peer] = 0
                     peer_session = resp.get("session")
+                    peer_ops = resp.get("ops")
+                    prev = last_ops.get(peer)
+                    if peer_session == "completed":
+                        # the completion transition is one last advance
+                        # (it may deliver this worker's pending value)
+                        if prev != "completed":
+                            any_advance = True
+                        last_ops[peer] = "completed"
+                    elif peer_ops is not None:
+                        if isinstance(prev, int) and peer_ops > prev:
+                            any_advance = True
+                        last_ops[peer] = peer_ops
                     if peer_session == "aborted":
                         # the peer killed this session but its fanout
                         # never reached us: adopt the abort instead of
                         # treating the live process as session liveness
+                        # (with the peer's typed root cause, when the
+                        # ping carried it)
                         reason = (
                             f"session aborted on peer {peer!r} "
                             "(learned via ping)"
                         )
-                        self._abort_local(session_id, reason=reason)
+                        self._abort_local(
+                            session_id, reason=reason,
+                            envelope=resp.get("abort_envelope"),
+                        )
                         return
                     if peer_session not in ("running", "completed"):
                         all_live = False
@@ -420,24 +547,55 @@ class WorkerServer:
                     )
                     misses[peer] += 2 if hard else 1
                     if misses[peer] >= trip_at:
+                        from .. import telemetry
+
                         reason = (
                             f"peer {peer!r} unreachable "
                             f"({misses[peer]} ping-miss points)"
                         )
-                        self._abort_local(session_id, reason=reason)
-                        survivors = [
-                            p for p in state.peers if p != peer
-                        ]
-                        self._fanout_abort(session_id, reason, survivors)
+                        envelope = to_wire(
+                            PeerUnreachableError(reason), self.identity
+                        )
+                        with telemetry.span(
+                            "detector_trip", session_id=session_id,
+                            party=self.identity, peer=peer,
+                            miss_points=misses[peer],
+                        ):
+                            self._abort_local(
+                                session_id, reason=reason,
+                                envelope=envelope,
+                            )
+                            survivors = [
+                                p for p in state.peers if p != peer
+                            ]
+                            self._fanout_abort(
+                                session_id, reason, survivors,
+                                envelope=envelope,
+                            )
                         return
             # a round where EVERY peer reports 'completed' cannot deliver
             # anything new to this worker's pending receives — bumping
             # progress would extend their deadlines forever when a value
             # this worker still awaits was never sent (role/graph
             # mismatch, dropped send); let the no-progress timeout fire
-            # instead (ADVICE r3)
-            if all_live and state.peers and not all_completed:
-                state.progress.bump()
+            # instead (ADVICE r3).  Liveness alone is not progress
+            # either, but live peers get a bounded stall_grace beyond
+            # the last real advance — one giant op may legitimately
+            # freeze every count for longer than the receive timeout.
+            if any_advance:
+                last_advance = time.monotonic()
+            if (
+                all_live and state.peers and not all_completed
+                and (
+                    any_advance
+                    or time.monotonic() - last_advance
+                    < self.stall_grace
+                )
+            ):
+                # extend, don't bump: a bump would raise OUR op count,
+                # which peers' detectors would read as an advance — a
+                # mutual-extension loop that never times out
+                state.progress.extend()
 
     def _send_value(self, request: bytes, context=None) -> bytes:
         # a peer's send may land after this worker aborted the session:
@@ -509,6 +667,17 @@ class WorkerServer:
         if self._server is not None:
             self._server.stop(grace)
             self._server = None
+
+    def _chaos_kill(self):
+        """Chaos ``kill_after_ops`` hook: die like a SIGKILL'd process —
+        stop answering RPCs abruptly (peers' pings see UNAVAILABLE and
+        their detectors trip) without aborting sessions, fanning out, or
+        otherwise saying goodbye.  The wrapped transport raises on every
+        subsequent op of this identity, so the run thread cannot limp
+        along either."""
+        server, self._server = self._server, None
+        if server is not None:
+            server.stop(0)
 
     def wait(self):
         self._server.wait_for_termination()

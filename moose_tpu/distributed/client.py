@@ -1,32 +1,293 @@
-"""Client runtime for a cluster of gRPC workers (reference
+"""Client session supervisor for a cluster of gRPC workers (reference
 GrpcMooseRuntime, execution/grpc.rs:11-146): compile the logical
 computation to the host-level graph, fan LaunchComputation out to every
-worker, retrieve + merge results and per-role timings."""
+worker IN PARALLEL, retrieve in parallel with first-error-wins, and —
+because a session is a pure function of (computation, arguments) and
+replay protection drops stale traffic for old ids — resubmit the whole
+computation under a fresh session id when the failure is *retryable*
+(transport fault, receive timeout, detector trip; see
+``errors.is_retryable``).  Permanent failures (compile/type errors,
+PERMISSION_DENIED) re-raise immediately as their original typed class,
+reconstructed from the wire envelope (``errors.from_wire``).
+
+Every run leaves a ``last_session_report`` on the runtime — attempts,
+per-party outcomes, injected chaos faults — mirroring
+``runtime.last_plan`` for the local executors."""
 
 from __future__ import annotations
 
+import random
 import secrets
+import threading
+import time
 from typing import Optional
 
-import numpy as np
-
+from .. import telemetry
 from ..computation import Computation
-from ..errors import NetworkingError
+from ..errors import (
+    AuthorizationError,
+    MooseError,
+    NetworkingError,
+    is_retryable,
+)
 from .choreography import ChoreographyClient
 
 
+def _retryable(exc: BaseException) -> bool:
+    """The wire bit when the error crossed the wire (the originator's
+    taxonomy already classified the live exception), the local taxonomy
+    otherwise."""
+    wire_bit = getattr(exc, "retryable", None)
+    return bool(wire_bit) if wire_bit is not None else is_retryable(exc)
+
+
+def _error_from_result(party: str, result: dict) -> MooseError:
+    """Typed exception for a worker's error cell.  Envelope-carrying
+    cells (every current worker) re-raise the REAL class; bare string
+    cells (older workers) degrade to a retryable NetworkingError."""
+    from ..errors import from_wire
+
+    envelope = result.get("envelope")
+    if envelope:
+        return from_wire(envelope)
+    exc = NetworkingError(f"worker {party} failed: {result['error']}")
+    exc.retryable = True
+    return exc
+
+
+def _classify_rpc_error(exc: BaseException, what: str) -> MooseError:
+    """Map a raw transport/launch failure into the taxonomy: mTLS /
+    choreographer rejections are permanent, everything else about an
+    unreachable or failing worker is retryable."""
+    if isinstance(exc, MooseError):
+        return exc
+    detail = str(exc)
+    try:
+        import grpc
+
+        if isinstance(exc, grpc.RpcError):
+            code = exc.code()
+            detail = f"{code.name}: {exc.details()}"
+            if code == grpc.StatusCode.PERMISSION_DENIED:
+                typed = AuthorizationError(f"{what}: {detail}")
+                typed.__cause__ = exc
+                return typed
+    except ModuleNotFoundError:  # pragma: no cover - grpc ships with repo
+        pass
+    typed = NetworkingError(f"{what}: {detail}")
+    typed.__cause__ = exc
+    return typed
+
+
+def _chaos_marks() -> list:
+    """Snapshot (config, fault-log length) for every live in-process
+    chaos config, so the report can attribute exactly the faults
+    injected during this run."""
+    from .chaos import active_configs
+
+    return [(cfg, len(cfg.faults)) for cfg in active_configs()]
+
+
+def _chaos_new_faults(marks: list) -> list:
+    faults = []
+    for cfg, mark in marks:
+        with cfg._lock:
+            faults.extend(dict(f) for f in cfg.faults[mark:])
+    return faults
+
+
 class GrpcClientRuntime:
-    def __init__(self, identities: dict, tls=None):
+    def __init__(self, identities: dict, tls=None, max_attempts: int = 3,
+                 backoff_base_s: float = 0.25, backoff_cap_s: float = 2.0):
         """``identities``: {identity/placement name: "host:port"};
         ``tls``: optional :class:`moose_tpu.distributed.tls.TlsConfig` —
         each worker must then present a certificate whose CN is its
-        identity name."""
+        identity name.  ``max_attempts``: how many times a RETRYABLE
+        failure resubmits the computation (fresh session id, capped
+        exponential backoff + jitter) before surfacing."""
         self.identities = dict(identities)
+        self.max_attempts = int(max_attempts)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         self._clients = {
             name: ChoreographyClient(endpoint, tls=tls,
                                      expected_identity=name)
             for name, endpoint in self.identities.items()
         }
+        # supervisor outcome of the most recent run_computation call:
+        # attempts, per-party errors, injected chaos faults (the
+        # distributed mirror of runtime.last_plan)
+        self.last_session_report: dict = {}
+
+    # -- one attempt ----------------------------------------------------
+
+    def _abort_parties(self, session_id: str, parties) -> None:
+        """Best-effort parallel abort — used to clean up launched
+        workers after a partial launch failure and to unblock survivors
+        after the first retrieve error, so no session outlives the
+        abort-fanout window."""
+        def one(name):
+            try:
+                self._clients[name].abort(session_id)
+            except Exception:  # noqa: BLE001 — target may be the dead one
+                pass
+
+        threads = [
+            threading.Thread(target=one, args=(p,), daemon=True)
+            for p in parties
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+
+    def _launch_all(self, session_id: str, comp_bytes: bytes,
+                    per_party_args: dict, attempt_rec: dict) -> None:
+        """Fan launches out in parallel.  On ANY failure the workers
+        that DID launch are aborted before the typed error is raised —
+        a partially-launched session must not sit in blocked receives
+        until the failure detector notices the missing party."""
+        launched: list = []
+        failures: dict = {}
+        lock = threading.Lock()
+
+        def one(name):
+            try:
+                resp = self._clients[name].launch(
+                    session_id, comp_bytes, per_party_args[name]
+                )
+                if not resp.get("ok"):
+                    raise NetworkingError(
+                        f"launch on {name} failed: {resp!r}"
+                    )
+                with lock:
+                    launched.append(name)
+            except Exception as e:  # noqa: BLE001 — classified below
+                with lock:
+                    failures[name] = _classify_rpc_error(
+                        e, f"launch on {name} failed"
+                    )
+
+        threads = [
+            threading.Thread(target=one, args=(n,), daemon=True)
+            for n in self._clients
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150.0)
+        with lock:
+            for name in self._clients:
+                # a launch thread still hanging after the join window is
+                # a FAILURE, not a success: treating it as launched
+                # would run the session against a party that may never
+                # have started (and exclude it from the abort sweep)
+                if name not in launched and name not in failures:
+                    exc = NetworkingError(
+                        f"launch on {name} timed out (no response)"
+                    )
+                    exc.retryable = True
+                    failures[name] = exc
+        if failures:
+            attempt_rec["errors"].update({
+                name: f"{type(e).__name__}: {e}"
+                for name, e in failures.items()
+            })
+            attempt_rec["status"] = "launch_failed"
+            if launched:
+                self._abort_parties(session_id, launched)
+            # surface a PERMANENT failure over a retryable one: if any
+            # party rejected the computation outright, retrying the
+            # transient co-failures would just replay the rejection
+            ranked = sorted(
+                failures.values(), key=_retryable
+            )
+            raise ranked[0]
+
+    def _retrieve_all(self, session_id: str, timeout: float,
+                      attempt_rec: dict) -> tuple:
+        """Retrieve every party in parallel; the FIRST error wins and
+        aborts the survivors (serial retrieval would hide a fast
+        failure behind a slow success)."""
+        from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor
+        from concurrent.futures import wait as futures_wait
+
+        from ..serde import deserialize_value
+        from ..values import HostUnit
+
+        def one(name):
+            try:
+                result = self._clients[name].retrieve(
+                    session_id, timeout=timeout
+                )
+            except Exception as e:  # noqa: BLE001 — classified
+                raise _classify_rpc_error(
+                    e, f"retrieve from {name} failed"
+                ) from e
+            if "error" in result:
+                raise _error_from_result(name, result)
+            return name, result
+
+        pool = ThreadPoolExecutor(
+            max_workers=max(1, len(self._clients)),
+            thread_name_prefix="moose-retrieve",
+        )
+        futs = {
+            pool.submit(one, name): name for name in self._clients
+        }
+        outputs: dict = {}
+        timings: dict = {}
+        try:
+            done, pending = futures_wait(
+                futs, timeout=timeout + 15.0,
+                return_when=FIRST_EXCEPTION,
+            )
+            errors: list = []
+            for fut in done:
+                name = futs[fut]
+                exc = fut.exception()
+                if exc is not None:
+                    attempt_rec["errors"][name] = (
+                        f"{type(exc).__name__}: {exc}"
+                    )
+                    errors.append(exc)
+                    continue
+                _, result = fut.result()
+                attempt_rec["errors"].setdefault(name, "ok")
+                timings[name] = result.get("elapsed_time_micros", 0)
+                for out_name, blob in (
+                    result.get("outputs") or {}
+                ).items():
+                    value = deserialize_value(blob)
+                    outputs[out_name] = (
+                        None if isinstance(value, HostUnit) else value
+                    )
+            if not errors and pending:
+                exc = NetworkingError(
+                    f"retrieve timed out after {timeout}s on "
+                    f"{sorted(futs[f] for f in pending)}"
+                )
+                exc.retryable = True
+                errors.append(exc)
+            # a PERMANENT error is canonical over any retryable
+            # co-failure (same ranking as _launch_all): fanout races
+            # can land a peer's adopted SessionAborted in the same
+            # FIRST_EXCEPTION wake-up as the real root cause, and
+            # replaying a deterministic failure just repeats it
+            first_error = (
+                sorted(errors, key=_retryable)[0] if errors else None
+            )
+            if first_error is not None:
+                attempt_rec["status"] = "retrieve_failed"
+                # unblock everyone still running before surfacing: the
+                # fastest failure is canonical, survivors are aborted
+                self._abort_parties(session_id, list(self._clients))
+                raise first_error
+        finally:
+            pool.shutdown(wait=False)
+        return outputs, timings
+
+    # -- the supervisor loop --------------------------------------------
 
     def run_computation(
         self,
@@ -34,17 +295,16 @@ class GrpcClientRuntime:
         arguments: Optional[dict] = None,
         timeout: float = 120.0,
         arg_specs: Optional[dict] = None,
+        max_attempts: Optional[int] = None,
     ):
-        """Compile + fan out + retrieve.  ``arg_specs`` supplies
-        shape/dtype specs the client cannot infer from ``arguments`` —
-        in particular for Load ops whose values live in worker-side
-        storage: ``{load_op_name: ((shape...), np_dtype)}``."""
+        """Compile + fan out + retrieve, retrying retryable failures.
+        ``arg_specs`` supplies shape/dtype specs the client cannot infer
+        from ``arguments`` — in particular for Load ops whose values
+        live in worker-side storage: ``{load_op_name: ((shape...),
+        np_dtype)}``."""
         from ..compilation import DEFAULT_PASSES, compile_computation
         from ..compilation.lowering import arg_specs_from_arguments
-        from ..serde import (
-            deserialize_value,
-            serialize_computation,
-        )
+        from ..serde import serialize_computation
 
         arguments = dict(arguments or {})
         specs = arg_specs_from_arguments(arguments)
@@ -55,7 +315,6 @@ class GrpcClientRuntime:
             arg_specs=specs,
         )
         comp_bytes = serialize_computation(compiled)
-        session_id = secrets.token_hex(16)
 
         # each worker receives ONLY the arguments whose Input op lives on
         # its placement — shipping the full cleartext dict to every party
@@ -66,36 +325,95 @@ class GrpcClientRuntime:
             for op in compiled.operations.values()
             if op.kind == "Input"
         }
-        for name, client in self._clients.items():
-            mine = {
+        per_party_args = {
+            name: {
                 arg: v for arg, v in arguments.items()
                 if owner_of.get(arg) == name
             }
-            resp = client.launch(session_id, comp_bytes, mine)
-            if not resp.get("ok"):
-                raise NetworkingError(
-                    f"launch on {name} failed: {resp!r}"
-                )
+            for name in self._clients
+        }
 
-        outputs: dict = {}
-        timings: dict = {}
-        for name, client in self._clients.items():
-            result = client.retrieve(session_id, timeout=timeout)
-            if "error" in result:
-                raise NetworkingError(
-                    f"worker {name} failed: {result['error']}"
-                )
-            timings[name] = result.get("elapsed_time_micros", 0)
-            for out_name, blob in (result.get("outputs") or {}).items():
-                value = deserialize_value(blob)
-                from ..values import HostUnit
+        attempts = (
+            self.max_attempts if max_attempts is None else int(max_attempts)
+        )
+        attempts = max(1, attempts)
+        marks = _chaos_marks()
+        report: dict = {
+            "ok": False,
+            "n_attempts": 0,
+            "max_attempts": attempts,
+            "attempts": [],
+            "faults_injected": [],
+        }
+        self.last_session_report = report
 
-                outputs[out_name] = (
-                    None if isinstance(value, HostUnit) else value
-                )
+        with telemetry.span(
+            "run_computation", parties=len(self._clients),
+            max_attempts=attempts,
+        ) as root:
+            try:
+                for attempt in range(1, attempts + 1):
+                    session_id = secrets.token_hex(16)
+                    attempt_rec = {
+                        "session_id": session_id,
+                        "status": "ok",
+                        "errors": {},
+                        "elapsed_s": 0.0,
+                    }
+                    report["attempts"].append(attempt_rec)
+                    report["n_attempts"] = attempt
+                    t0 = time.monotonic()
+                    with telemetry.span(
+                        "attempt", attempt=attempt, session_id=session_id,
+                    ):
+                        try:
+                            with telemetry.span("launch"):
+                                self._launch_all(
+                                    session_id, comp_bytes,
+                                    per_party_args, attempt_rec,
+                                )
+                            with telemetry.span("retrieve"):
+                                outputs, timings = self._retrieve_all(
+                                    session_id, timeout, attempt_rec
+                                )
+                        except Exception as exc:
+                            attempt_rec["elapsed_s"] = (
+                                time.monotonic() - t0
+                            )
+                            attempt_rec["error"] = (
+                                f"{type(exc).__name__}: {exc}"
+                            )
+                            attempt_rec["retryable"] = _retryable(exc)
+                            if (
+                                not attempt_rec["retryable"]
+                                or attempt >= attempts
+                            ):
+                                raise
+                            # capped exponential backoff + jitter before
+                            # the resubmission (fresh session id; replay
+                            # protection drops stragglers of this one)
+                            delay = min(
+                                self.backoff_cap_s,
+                                self.backoff_base_s * 2 ** (attempt - 1),
+                            )
+                            delay += random.uniform(0, delay / 2)
+                            with telemetry.span(
+                                "backoff", seconds=round(delay, 3)
+                            ):
+                                time.sleep(delay)
+                            continue
+                    attempt_rec["elapsed_s"] = time.monotonic() - t0
+                    report["ok"] = True
+                    root.attrs["attempts_used"] = attempt
+                    break
+            finally:
+                report["faults_injected"] = _chaos_new_faults(marks)
+                report["retried"] = report["n_attempts"] > 1
+
         from ..execution.interpreter import ordered_output_names
 
         outputs = {
             name: outputs[name] for name in ordered_output_names(outputs)
         }
+        report["timings"] = dict(timings)
         return outputs, timings

@@ -49,14 +49,31 @@ class ProgressClock:
     dispatches all receives at launch, so a fixed per-op deadline would
     spuriously kill long pipelines)."""
 
-    __slots__ = ("last",)
+    __slots__ = ("last", "count")
 
     def __init__(self):
         import time as _time
 
         self.last = _time.monotonic()
+        # monotone completion counter: pings report it so a peer can
+        # distinguish "alive and advancing" from "alive but stuck" —
+        # only the former may extend blocked receives (a dropped send
+        # would otherwise let mutually-blocked live workers extend each
+        # other's deadlines forever)
+        self.count = 0
 
     def bump(self):
+        import time as _time
+
+        self.last = _time.monotonic()
+        self.count += 1
+
+    def extend(self):
+        """Extend the deadline WITHOUT claiming an op completed.  The
+        failure detector uses this when peers report real advances:
+        counting its own extension as progress would let two mutually
+        blocked workers read each other's detector activity as op
+        advances and extend forever."""
         import time as _time
 
         self.last = _time.monotonic()
@@ -77,9 +94,11 @@ def sliced_wait(wait_slice, timeout: float, cancel, what: str,
 
     from ..errors import SessionAbortedError
 
+    from ..errors import ReceiveTimeoutError
+
     if cancel is None and progress is None:
         if not wait_slice(timeout):
-            raise NetworkingError(
+            raise ReceiveTimeoutError(
                 f"receive timed out after {timeout}s for {what!r}"
             )
         return
@@ -93,7 +112,7 @@ def sliced_wait(wait_slice, timeout: float, cancel, what: str,
             deadline = max(deadline, progress.last + timeout)
         remaining = deadline - _time.monotonic()
         if remaining <= 0:
-            raise NetworkingError(
+            raise ReceiveTimeoutError(
                 f"receive timed out after {timeout}s (no session "
                 f"progress) for {what!r}"
             )
@@ -115,6 +134,13 @@ class _CellStore:
         self._lock = threading.Lock()
         self._values: dict = {}
         self._events: dict = {}
+        # keys already consumed by a receive: a duplicate delivery
+        # (gRPC retry, chaos dup_send) of a consumed key must be
+        # DROPPED, not re-posted — sessions never reuse a rendezvous
+        # key, so a re-put could only recreate a never-consumed cell
+        # (a slow leak) or hand a stale copy to nobody.  Bounded LRU,
+        # same discipline as the session-id bookkeeping.
+        self._delivered: "OrderedDict[str, None]" = OrderedDict()
         # per-session arrival wakeups: each session's receive poller
         # sleeps on ITS event — a shared one would let one session's
         # poller swallow another's wakeup (clear/wait race), degrading
@@ -122,6 +148,12 @@ class _CellStore:
         # busy long-lived session is never evicted by short-session
         # churn (every touch refreshes recency).
         self._activity: "OrderedDict[str, threading.Event]" = OrderedDict()
+
+    def _mark_delivered(self, key: str) -> None:
+        # caller holds self._lock
+        self._delivered[key] = None
+        while len(self._delivered) > self._MAX_ACTIVITY:
+            self._delivered.popitem(last=False)
 
     def activity_for(self, session_id: str):
         with self._lock:
@@ -137,6 +169,8 @@ class _CellStore:
     def put(self, key: str, value):
         session_id = key.split("/", 1)[0]
         with self._lock:
+            if key in self._delivered:
+                return  # duplicate delivery of a consumed key: drop
             self._values[key] = value
             ev = self._events.get(key)
             if ev is None:
@@ -149,6 +183,7 @@ class _CellStore:
         with self._lock:
             if key in self._values:
                 self._events.pop(key, None)
+                self._mark_delivered(key)
                 return True, self._values.pop(key)
         return False, None
 
@@ -162,6 +197,7 @@ class _CellStore:
             # single-consumer: drop the cell after use (sessions never
             # reuse a rendezvous key)
             self._events.pop(key, None)
+            self._mark_delivered(key)
             return self._values.pop(key)
 
     def drop_session(self, session_id: str) -> int:
@@ -389,10 +425,13 @@ class GrpcNetworking:
         return msgpack.unpackb(raw, raw=False) if raw else {}
 
     def abort_session(self, receiver: str, session_id: str,
-                      reason: str, timeout: float = 3.0):
+                      reason: str, timeout: float = 3.0,
+                      envelope: Optional[dict] = None):
         """Participant-level abort on a peer (first-error fanout). No
         retry: a fanout target that is down is already failing the
-        session its own way."""
+        session its own way.  ``envelope`` (errors.to_wire) carries the
+        typed root cause so the peer's result cell keeps the real error
+        class."""
         import msgpack
 
         payload = msgpack.packb(
@@ -400,6 +439,7 @@ class GrpcNetworking:
                 "session_id": session_id,
                 "reason": reason,
                 "sender": self._identity,
+                "envelope": envelope,
             },
             use_bin_type=True,
         )
@@ -475,7 +515,9 @@ class GrpcNetworking:
                     isinstance(e, grpc.RpcError)
                     and e.code() == grpc.StatusCode.PERMISSION_DENIED
                 ):
-                    raise NetworkingError(
+                    from ..errors import AuthorizationError
+
+                    raise AuthorizationError(
                         f"send to {receiver!r} rejected: {e}"
                     ) from e
                 if time.monotonic() > deadline:

@@ -186,10 +186,37 @@ def execute_role(
                 progress=progress,
             )
         if kind == "PrfKeyGen":
-            # each party generates its own key from local entropy — this
-            # is where the distributed deployment gets real inter-party
-            # security, unlike the single-trust-domain local runtime
-            words = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
+            fixed = os.environ.get("MOOSE_TPU_FIXED_KEYS")
+            if fixed:
+                # TEST-ONLY determinism: replicated fixed-point results
+                # carry +-1 LSB of share-dependent truncation noise, so
+                # the chaos layer's bit-exactness checks (chaos run vs
+                # clean run, retry vs first attempt) need reproducible
+                # keys.  Gated like the weak default PRF: a real
+                # deployment must never run with derivable keys.
+                if os.environ.get("MOOSE_TPU_ALLOW_WEAK_PRF") != "1":
+                    from ..errors import ConfigurationError
+
+                    raise ConfigurationError(
+                        "MOOSE_TPU_FIXED_KEYS is a testing knob and "
+                        "requires MOOSE_TPU_ALLOW_WEAK_PRF=1 — fixed "
+                        "PRF keys void all inter-party secrecy"
+                    )
+                import hashlib
+
+                digest = hashlib.blake2b(
+                    f"{fixed}|{identity}|{op.name}".encode(),
+                    digest_size=16,
+                ).digest()
+                words = np.frombuffer(digest, dtype=np.uint32)
+            else:
+                # each party generates its own key from local entropy —
+                # this is where the distributed deployment gets real
+                # inter-party security, unlike the single-trust-domain
+                # local runtime
+                words = np.frombuffer(
+                    secrets.token_bytes(16), dtype=np.uint32
+                )
             return HostPrfKey(jnp.asarray(words), identity)
         if kind == "Input":
             val = arguments.get(op.name)
@@ -341,12 +368,12 @@ def execute_role(
             if items and not arrived and (
                 time.monotonic() > progress.last + timeout
             ):
-                from ..errors import NetworkingError
+                from ..errors import ReceiveTimeoutError
 
                 keys = sorted(
                     op.attributes["rendezvous_key"] for _, op in items
                 )[:4]
-                fail(NetworkingError(
+                fail(ReceiveTimeoutError(
                     f"receive timed out after {timeout}s of no session "
                     f"progress; {len(items)} pending (first keys "
                     f"{keys})"

@@ -56,6 +56,19 @@ class ReceiveTimeoutError(NetworkingError, TimeoutError):
     changes)."""
 
 
+class AuthorizationError(NetworkingError):
+    """A peer rejected the request on identity grounds (mTLS CN
+    mismatch, unauthorized choreographer — gRPC PERMISSION_DENIED).
+    Permanent: resubmitting the same credentials can never succeed, so
+    the session supervisor must NOT retry it."""
+
+
+class PeerUnreachableError(NetworkingError):
+    """The failure detector tripped: a session peer stopped answering
+    pings for the configured miss budget.  Retryable — the peer may be
+    restarting or the partition transient."""
+
+
 class StorageError(MooseError, KeyError):
     """Load/Save against a storage backend failed (reference
     Error::Storage)."""
@@ -80,3 +93,99 @@ class UnimplementedError(MooseError, NotImplementedError):
 
 class ConfigurationError(MooseError, ValueError):
     """Invalid runtime/session configuration."""
+
+
+# ---------------------------------------------------------------------------
+# Typed wire errors: structured envelopes for the distributed runtime.
+#
+# The reference stringifies errors at the session boundary (its abort
+# handler is unimplemented!(), choreography/grpc.rs:200); here a failure
+# crosses the wire as a small msgpack-able dict so the CLIENT re-raises
+# the real typed exception and the session supervisor can tell transient
+# faults (resubmit) from permanent ones (surface immediately).
+# ---------------------------------------------------------------------------
+
+# Classes whose failures can be healed by resubmitting the computation
+# under a fresh session id: transport faults, receive timeouts, detector
+# trips, and adopted aborts whose root cause never reached us.  Anything
+# authorization-shaped is excluded — same credentials, same rejection.
+_PERMANENT_NETWORKING = (AuthorizationError,)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """True when resubmitting the same (computation, arguments) under a
+    fresh session id can plausibly succeed.  Sessions are pure functions
+    of their inputs and replay protection drops stale traffic for old
+    ids, so the supervisor may replay any *transient* failure; compile
+    and type errors (and PERMISSION_DENIED) are deterministic and must
+    surface immediately."""
+    if isinstance(exc, _PERMANENT_NETWORKING):
+        return False
+    return isinstance(exc, (NetworkingError, SessionAbortedError))
+
+
+def _class_registry() -> dict:
+    return {
+        cls.__name__: cls
+        for cls in list(globals().values())
+        if isinstance(cls, type) and issubclass(cls, MooseError)
+    }
+
+
+def _cause_chain(exc: BaseException, limit: int = 8) -> list:
+    """[{class, message}] for the __cause__/__context__ chain below
+    ``exc`` (nearest first), bounded so a pathological chain cannot
+    bloat the wire frame."""
+    chain = []
+    seen = {id(exc)}
+    cur = exc.__cause__ or exc.__context__
+    while cur is not None and len(chain) < limit and id(cur) not in seen:
+        seen.add(id(cur))
+        chain.append({
+            "class": type(cur).__name__,
+            "message": str(cur),
+        })
+        cur = cur.__cause__ or cur.__context__
+    return chain
+
+
+def to_wire(exc: BaseException, party: str = "") -> dict:
+    """Encode an exception as a wire envelope: error class, originating
+    party, root-cause chain, and the retryable bit derived from the
+    taxonomy.  msgpack-able (strings/bools only)."""
+    return {
+        "class": type(exc).__name__,
+        "message": str(exc),
+        "party": party,
+        "retryable": bool(is_retryable(exc)),
+        "chain": _cause_chain(exc),
+    }
+
+
+def from_wire(envelope: dict) -> MooseError:
+    """Decode an envelope back into a typed exception.  The class is
+    resolved by name against this module's taxonomy; a class the local
+    build does not know (version skew, non-Moose root cause) degrades to
+    :class:`NetworkingError` with the original name preserved in the
+    message.  The instance carries ``party`` / ``retryable`` /
+    ``wire_chain`` attributes for programmatic inspection."""
+    name = envelope.get("class", "NetworkingError")
+    cls = _class_registry().get(name)
+    message = envelope.get("message", "")
+    party = envelope.get("party", "")
+    if cls is None:
+        message = f"{name}: {message}"
+        cls = NetworkingError
+    if party:
+        message = f"{message} (party {party})"
+    exc = cls(message)
+    exc.party = party
+    # trust the wire bit over local re-derivation: the ORIGINATOR'S
+    # taxonomy classified the live exception (a degraded unknown class
+    # would otherwise flip permanent -> retryable)
+    exc.retryable = bool(envelope.get("retryable", False))
+    exc.wire_chain = tuple(
+        (c.get("class", ""), c.get("message", ""))
+        for c in envelope.get("chain") or ()
+    )
+    return exc

@@ -438,6 +438,10 @@ class GrpcMooseRuntime:
         # per-role elapsed micros of the most recent run (reference
         # GrpcMooseRuntime, pymoose/src/bindings.rs:320-328)
         self.last_timings: Dict[str, int] = {}
+        # supervisor outcome of the most recent run: attempts,
+        # per-party errors, injected chaos faults (mirrors
+        # LocalMooseRuntime.last_plan)
+        self.last_session_report: Dict = {}
 
     def set_default(self):
         edsl_base.set_current_runtime(self)
@@ -445,8 +449,13 @@ class GrpcMooseRuntime:
     def evaluate_computation(self, computation, arguments=None,
                              timeout: float = 120.0):
         computation, arguments = _lift_computation(computation, arguments)
-        outputs, timings = self._client.run_computation(
-            computation, arguments, timeout=timeout
-        )
+        try:
+            outputs, timings = self._client.run_computation(
+                computation, arguments, timeout=timeout
+            )
+        finally:
+            self.last_session_report = dict(
+                self._client.last_session_report
+            )
         self.last_timings = dict(timings)
         return outputs, timings

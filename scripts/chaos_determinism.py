@@ -1,0 +1,158 @@
+"""Chaos determinism check (CI): run the 3-party distributed secure-dot
+TWICE under one fixed MOOSE_TPU_CHAOS schedule and fail on ANY
+divergence between the two runs — fault schedule (drop/dup/kill
+decisions), supervisor outcome (ok / final error class / attempts
+used), and, for successful runs, the output bytes.
+
+    python scripts/chaos_determinism.py --chaos "seed:85,drop_send:0.2"
+    python scripts/chaos_determinism.py \
+        --chaos "seed:7,kill_after_ops:2,party:carole,fail_ping:0.2"
+
+The chaos layer's whole contract is that a seed IS the fault schedule;
+this script is the regression guard for that contract (the same check
+the tier-1 suite makes once, made twice and compared).  Keys and
+trace-time nonces are pinned so outputs are bit-comparable (weak-PRF
+escape hatch: this is a single-process test cluster, not a deployment).
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MOOSE_TPU_ALLOW_WEAK_PRF"] = "1"
+os.environ["MOOSE_TPU_FIXED_KEYS"] = "chaos-determinism"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+# decisions whose occurrence count is schedule, not timing (fail_ping
+# entries scale with how many detector rounds ran — excluded)
+_SCHEDULE_KINDS = {"drop_send", "dup_send", "kill"}
+
+
+def _secure_dot():
+    import moose_tpu as pm
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    return comp
+
+
+def run_once(chaos_spec: str) -> dict:
+    """One fresh cluster + client run under a fresh schedule; returns
+    the comparable outcome."""
+    import numpy as np
+
+    from moose_tpu.dialects import host as host_dialect
+    from moose_tpu.distributed.chaos import ChaosConfig
+    from moose_tpu.distributed.choreography import WorkerServer
+    from moose_tpu.distributed.client import GrpcClientRuntime
+    from moose_tpu.edsl import tracer
+
+    chaos = ChaosConfig.from_env(chaos_spec)
+    if chaos is None:
+        raise SystemExit("--chaos spec parsed to no chaos; nothing to check")
+    servers, endpoints = {}, {}
+    for i in ("alice", "bob", "carole"):
+        srv = WorkerServer(
+            i, 0, {}, ping_interval=0.25, ping_misses=2,
+            startup_grace=5.0, receive_timeout=4.0, stall_grace=0.5,
+            chaos=chaos,
+        ).start()
+        servers[i] = srv
+        endpoints[i] = f"127.0.0.1:{srv.port}"
+    for srv in servers.values():
+        srv.endpoints.update(endpoints)
+        srv.networking._endpoints.update(endpoints)
+
+    rng = np.random.default_rng(0)
+    args = {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+    outcome = {"ok": False, "error": None, "n_attempts": 0}
+    try:
+        runtime = GrpcClientRuntime(
+            endpoints, max_attempts=3, backoff_base_s=0.05,
+            backoff_cap_s=0.2,
+        )
+        with host_dialect.deterministic_sync_keys(1234):
+            try:
+                outputs, _ = runtime.run_computation(
+                    tracer.trace(_secure_dot()), args, timeout=30.0
+                )
+                outcome["ok"] = True
+                digest = hashlib.blake2b(digest_size=16)
+                for name in sorted(outputs):
+                    digest.update(name.encode())
+                    digest.update(np.ascontiguousarray(
+                        np.asarray(outputs[name])
+                    ).tobytes())
+                outcome["outputs"] = digest.hexdigest()
+            except Exception as e:  # noqa: BLE001 — outcome, not crash
+                # the exact class is race-dependent under kill chaos
+                # (own-detector PeerUnreachable vs adopted abort vs raw
+                # UNAVAILABLE may each win); what IS schedule-determined
+                # is that the run failed and how the supervisor
+                # classified it
+                from moose_tpu.distributed.client import _retryable
+
+                outcome["error"] = (
+                    "retryable" if _retryable(e) else "permanent"
+                )
+        outcome["n_attempts"] = runtime.last_session_report.get(
+            "n_attempts", 0
+        )
+        outcome["schedule"] = chaos.schedule_digest(kinds=_SCHEDULE_KINDS)
+        outcome["faults"] = sorted(
+            (f["kind"], f.get("key", f.get("party", "")))
+            for f in chaos.faults if f["kind"] in _SCHEDULE_KINDS
+        )
+    finally:
+        for srv in servers.values():
+            srv.stop()
+    return outcome
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--chaos", required=True,
+        help="MOOSE_TPU_CHAOS spec, e.g. 'seed:85,drop_send:0.2'",
+    )
+    args = parser.parse_args(argv)
+
+    first = run_once(args.chaos)
+    second = run_once(args.chaos)
+    print(json.dumps({"run1": first, "run2": second}, indent=2))
+    if first != second:
+        print(
+            f"NON-DETERMINISTIC outcome under chaos spec "
+            f"{args.chaos!r}", file=sys.stderr,
+        )
+        return 1
+    print(f"deterministic under {args.chaos!r}: "
+          f"schedule={first['schedule']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -409,6 +409,21 @@ def test_cse_candidate_fires_msa402():
     assert d.severity is Severity.INFO
 
 
+def test_duplicate_output_tag_fires_msa403():
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("out", "Output", ["x"], "alice", SIG1,
+                                 {"tag": "y"}))
+    comp.add_operation(Operation("out2", "Output", ["x"], "alice", SIG1,
+                                 {"tag": "y"}))
+    diags = analyze(comp, analyses=["hygiene"])
+    (d,) = [d for d in diags if d.rule == "MSA403"]
+    assert d.op == "out2" and "'out'" in d.message
+    assert d.severity is Severity.ERROR
+
+
 def test_ndarray_attributes_are_structurally_compared():
     comp = Computation()
     _hosts(comp, "alice")

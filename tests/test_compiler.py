@@ -218,6 +218,49 @@ def test_well_formed_send_receive_attributes():
     well_formed_check(comp)
 
 
+def test_well_formed_rejects_duplicate_output_tags():
+    """Two Output ops sharing a tag silently overwrite each other's
+    results-dict entry in every executor (ADVICE r5 low #2) — the
+    well-formedness check must reject the graph up front."""
+    from moose_tpu.compilation.well_formed import well_formed_check
+    from moose_tpu.errors import MalformedComputationError
+
+    def base():
+        comp = Computation()
+        comp.add_placement(HostPlacement("alice"))
+        sig0 = Signature((), HostFloat64TensorTy)
+        one = Signature((HostFloat64TensorTy,), HostFloat64TensorTy)
+        comp.add_operation(Operation("x", "Input", [], "alice", sig0))
+        return comp, one
+
+    comp, one = base()
+    comp.add_operation(Operation(
+        "out_a", "Output", ["x"], "alice", one, {"tag": "y"}))
+    comp.add_operation(Operation(
+        "out_b", "Output", ["x"], "alice", one, {"tag": "y"}))
+    with pytest.raises(MalformedComputationError,
+                       match="duplicate Output tag 'y'"):
+        well_formed_check(comp)
+
+    # an explicit tag colliding with another Output's default (name) tag
+    comp, one = base()
+    comp.add_operation(Operation(
+        "out_a", "Output", ["x"], "alice", one))
+    comp.add_operation(Operation(
+        "out_b", "Output", ["x"], "alice", one, {"tag": "out_a"}))
+    with pytest.raises(MalformedComputationError,
+                       match="duplicate Output tag 'out_a'"):
+        well_formed_check(comp)
+
+    # distinct tags pass
+    comp, one = base()
+    comp.add_operation(Operation(
+        "out_a", "Output", ["x"], "alice", one, {"tag": "y0"}))
+    comp.add_operation(Operation(
+        "out_b", "Output", ["x"], "alice", one, {"tag": "y1"}))
+    well_formed_check(comp)
+
+
 def test_prune_unknown_input_raises_malformed():
     from moose_tpu.errors import MalformedComputationError
 

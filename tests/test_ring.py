@@ -234,3 +234,63 @@ def test_integer_encode_is_exact_beyond_float_mantissa():
     np.testing.assert_array_equal(
         np.asarray(hi), np.full(2, 2**64 - 1, dtype=np.uint64)
     )
+
+
+# ---------------------------------------------------------------------------
+# Bit-draw domain separation (ADVICE r5 low #1): sample_bits_seeded and
+# sample_uniform_seeded must never share a PRF counter stream, on EVERY
+# backend — a reused seed across a uniform mask draw and a bit draw would
+# otherwise yield correlated shares.
+# ---------------------------------------------------------------------------
+
+
+_SEP_SEED = np.array([11, 22, 33, 44], dtype=np.uint32)
+
+
+@pytest.mark.parametrize("impl", ["rbg", "threefry", "aes-ctr"])
+def test_bit_draw_domain_separated_from_uniform_draw(impl):
+    """The bit stream must come from the TAGGED key, not the raw seed's
+    stream: compare against what the UNTAGGED key would produce (the
+    pre-fix behavior) and require a different draw."""
+    import jax
+
+    ring.set_prf_impl(impl)
+    try:
+        lo, hi = ring.sample_bits_seeded((257,), _SEP_SEED, 64)
+        bits = np.asarray(lo)
+        assert set(np.unique(bits)) <= {0, 1}
+        if impl == "aes-ctr":
+            from moose_tpu.crypto.aes_prng import AesCtrRng
+
+            untagged = AesCtrRng(
+                np.asarray(_SEP_SEED, np.uint32).tobytes()
+            ).bits(257).astype(np.uint64)
+        else:
+            key = ring._key_from_seed(_SEP_SEED)
+            untagged = np.asarray(
+                jax.random.bits(key, (257,), dtype=np.uint8)
+                & np.uint8(1)
+            ).astype(np.uint64)
+        assert not np.array_equal(bits, untagged), (
+            f"{impl}: bit draw still uses the untagged uniform-stream key"
+        )
+    finally:
+        ring.set_prf_impl("rbg")
+
+
+@pytest.mark.parametrize("impl", ["rbg", "threefry", "aes-ctr"])
+def test_bit_and_uniform_draws_differ_under_one_seed(impl):
+    """Fixed seed, both samplers: the two outputs must be distinct
+    streams (regression for the shared-counter correlation)."""
+    ring.set_prf_impl(impl)
+    try:
+        bits, _ = ring.sample_bits_seeded((256,), _SEP_SEED, 64)
+        uniform, _ = ring.sample_uniform_seeded((256,), _SEP_SEED, 64)
+        assert not np.array_equal(
+            np.asarray(bits), np.asarray(uniform) & np.uint64(1)
+        )
+        # determinism within a backend still holds
+        bits2, _ = ring.sample_bits_seeded((256,), _SEP_SEED, 64)
+        np.testing.assert_array_equal(np.asarray(bits), np.asarray(bits2))
+    finally:
+        ring.set_prf_impl("rbg")
