@@ -304,6 +304,115 @@ def bench_mlp_inference(batch=1024, features=100):
     return _bench_predictor(comp, {"x": x}, check, batch)
 
 
+def bench_logreg_serving(clients=64, requests_per_client=6, features=100,
+                         max_batch=256):
+    """Serving-layer closed loop (ISSUE 4 acceptance): 64 concurrent
+    client threads over a warm-registered logreg model, dynamic
+    micro-batching coalescing them into padded power-of-two buckets.
+    Returns (concurrent req/s, single-request req/s through the same
+    server, metrics snapshot).  The registry promise is ASSERTED here:
+    zero re-traces and zero ladder (validating) evaluations after
+    warmup — a violation fails the bench loudly instead of reporting a
+    fast-but-cold number."""
+    import threading
+
+    from sklearn.linear_model import LogisticRegression
+
+    from moose_tpu import predictors
+    from moose_tpu.predictors.sklearn_export import logistic_regression_onnx
+    from moose_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(7)
+    x_train = rng.normal(size=(256, features))
+    y_train = (rng.uniform(size=256) > 0.5).astype(int)
+    sk = LogisticRegression().fit(x_train, y_train)
+    model = predictors.from_onnx(
+        logistic_regression_onnx(sk, features).encode()
+    )
+    config = ServingConfig.from_env(
+        max_batch=max_batch, max_wait_ms=2.0, queue_bound=4096
+    )
+    # context-managed so a mid-bench failure (accuracy assert, client
+    # error) cannot leak scheduler threads + the warm runtime into the
+    # benchmarks that follow
+    with InferenceServer(config=config) as server:
+        # bucket subset: 64 closed-loop clients coalesce into <=64-row
+        # batches in practice; warming every power of two would spend
+        # minutes compiling plans the loop never uses
+        server.register_model(
+            "logreg", model, row_shape=(features,),
+            buckets=(1, clients, max_batch),
+        )
+        rows = rng.normal(size=(clients, requests_per_client, features))
+        # accuracy spot-check through the serving path before any timing
+        got = server.predict("logreg", rows[0, 0])
+        err = np.abs(got - sk.predict_proba(rows[0, 0:1])).max()
+        assert err < 5e-3, f"serving logreg mismatch: {err}"
+
+        def run_closed_loop():
+            barrier = threading.Barrier(clients + 1)
+            failures = []
+
+            def client(ci):
+                try:
+                    barrier.wait()
+                    for ri in range(requests_per_client):
+                        server.predict(
+                            "logreg", rows[ci, ri], timeout_s=600.0
+                        )
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    failures.append(repr(e))
+
+            threads = [
+                threading.Thread(target=client, args=(ci,))
+                for ci in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if failures:
+                raise RuntimeError(
+                    f"serving clients failed: {failures[:3]}"
+                )
+            return clients * requests_per_client / elapsed
+
+        run_closed_loop()  # warm every bucket the loop actually hits
+        # the snapshot below must describe ONLY the timed loop — drop
+        # the warm-up loop's (and spot-check's) traffic from the
+        # aggregates
+        server.metrics.reset_window()
+        per_sec_concurrent = run_closed_loop()
+        # fill/histogram of the timed concurrent loop, before the
+        # single-request floor below dilutes them with bucket-1 batches
+        snap = server.metrics_snapshot()
+
+        # the single-request floor the batcher exists to beat: one
+        # client, sequential, batch-of-one buckets through the SAME
+        # warm server
+        n_single = min(32, clients * requests_per_client)
+        t0 = time.perf_counter()
+        for i in range(n_single):
+            server.predict(
+                "logreg", rows[i % clients, 0], timeout_s=600.0
+            )
+        per_sec_single = n_single / (time.perf_counter() - t0)
+
+        final = server.metrics_snapshot()
+    snap["retraces_after_warm"] = final["retraces_after_warm"]
+    snap["validating_after_warm"] = final["validating_after_warm"]
+    assert snap["retraces_after_warm"] == 0, (
+        f"warm model re-traced: {snap}"
+    )
+    assert snap["validating_after_warm"] == 0, (
+        f"warm model re-ran the self-check ladder: {snap}"
+    )
+    return per_sec_concurrent, per_sec_single, snap
+
+
 def _chained_secure_dot_s(mk, da, db, t_iters=10):
     """Amortized per-dot seconds with T secure dots chained inside ONE
     jit program (lax.scan, fresh per-step session keys, scalar readback):
@@ -496,6 +605,28 @@ def main():
     except Exception as e:  # the headline metric must still print
         print(f"# logreg inference bench failed: {e}")
     emit()
+
+    # serving layer: 64-client closed loop through the micro-batching
+    # InferenceServer vs the single-request floor on the same machine
+    # (ISSUE 4: the ~7.6x batch-1024 throughput cliff, closed for
+    # concurrent traffic by coalescing)
+    try:
+        if _within_budget():
+            per_sec_c, per_sec_1, snap = bench_logreg_serving()
+            record["serving_logreg_per_sec_concurrent"] = per_sec_c
+            record["serving_logreg_per_sec_single"] = per_sec_1
+            record["serving_speedup_vs_single"] = per_sec_c / per_sec_1
+            record["serving_batch_fill_ratio"] = snap["batch_fill_ratio"]
+            record["serving_batch_size_hist"] = {
+                str(k): v for k, v in snap["batch_size_hist"].items()
+            }
+            record["serving_request_p99_s"] = snap[
+                "request_latency_p99_s"
+            ]
+            record["serving_deadline_misses"] = snap["deadline_misses"]
+            emit()
+    except Exception as e:
+        print(f"# serving bench failed: {e}")
 
     # BASELINE.json configs: batch-1024 encrypted inference
     try:

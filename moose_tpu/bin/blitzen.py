@@ -1,0 +1,266 @@
+"""blitzen: secure-inference serving daemon — warm model registry +
+dynamic micro-batching over an HTTP/JSON front end (stdlib-only, like
+the telemetry exporter: nothing to install in the serving image).
+
+  python -m moose_tpu.bin.blitzen logreg=model.onnx --port 9000
+
+  POST /v1/models/<name>:predict   {"x": [[...], ...]}  ->  {"y": [...]}
+  GET  /v1/metrics                 serving telemetry snapshot (JSON)
+  GET  /healthz                    {"status": "ok", "models": [...]}
+
+Every model file is an ONNX graph imported through ``from_onnx`` (the
+same path the examples use); registration traces, compiles each batch
+bucket, and drives the validated-jit ladder to steady state BEFORE the
+socket opens, so the first request is as fast as the millionth.
+Backpressure surfaces as HTTP 429 (queue full) and 504 (deadline
+expired) with the typed error class in the JSON body.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from pathlib import Path
+
+
+def parse_models(specs) -> dict:
+    """name=path.onnx pairs (bare paths name themselves by stem)."""
+    out = {}
+    for spec in specs:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = Path(spec).stem, spec
+        out[name.strip()] = path.strip()
+    return out
+
+
+def build_server(model_paths: dict, row_features: dict, args):
+    """Construct + warm an InferenceServer (shared by serve and
+    --oneshot; tests call this directly)."""
+    from moose_tpu import predictors
+    from moose_tpu.serving import InferenceServer, ServingConfig
+
+    config = ServingConfig.from_env(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_bound=args.queue_bound,
+        default_deadline_ms=args.deadline_ms,
+    )
+    server = InferenceServer(config=config)
+    for name, path in model_paths.items():
+        raw = Path(path).read_bytes()
+        model = predictors.from_onnx(raw)
+        n_features = row_features.get(name)
+        if n_features is None:
+            # the ONNX input declaration carries the row width; an
+            # explicit --features NAME=N overrides it
+            from moose_tpu.predictors import onnx_proto, predictor_utils
+
+            try:
+                n_features = predictor_utils.input_n_features(
+                    onnx_proto.load_model(raw)
+                )
+                if n_features < 1:
+                    # protobuf reports a symbolic (dim_param) feature
+                    # dim as dim_value 0 — not inferrable either
+                    raise ValueError(
+                        "the input declares a symbolic/zero feature dim"
+                    )
+            except (ValueError, IndexError) as e:
+                raise SystemExit(
+                    f"--features {name}=N is required (could not infer "
+                    f"the row width from the ONNX input: {e})"
+                ) from e
+        try:
+            n_features = int(n_features)
+        except ValueError:
+            raise SystemExit(
+                f"--features {name}={n_features}: N must be an integer"
+            ) from None
+        if n_features < 1:
+            # covers the explicit --features NAME=0 path too (the
+            # inference branch above has its own symbolic-dim guard)
+            raise SystemExit(
+                f"--features {name}={n_features}: N must be >= 1"
+            )
+        server.register_model(name, model, row_shape=(n_features,))
+    return server
+
+
+def _make_handler(server):
+    from concurrent.futures import TimeoutError as FutureTimeoutError
+    from http.server import BaseHTTPRequestHandler
+
+    from moose_tpu.errors import ConfigurationError, ServerOverloadedError
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *log_args):  # quiet by default
+            if os.environ.get("MOOSE_TPU_TRACE", "0") not in ("0", ""):
+                super().log_message(fmt, *log_args)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(
+                    200,
+                    {"status": "ok", "models": server.registry.names()},
+                )
+            elif self.path == "/v1/metrics":
+                self._reply(200, server.metrics_snapshot())
+            else:
+                self._reply(404, {"error": "NotFound", "path": self.path})
+
+        def do_POST(self):
+            prefix, suffix = "/v1/models/", ":predict"
+            if not (
+                self.path.startswith(prefix)
+                and self.path.endswith(suffix)
+            ):
+                self._reply(404, {"error": "NotFound", "path": self.path})
+                return
+            name = self.path[len(prefix):-len(suffix)]
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                request = json.loads(self.rfile.read(length) or b"{}")
+                deadline_ms = request.get("deadline_ms")
+                if deadline_ms is not None and not isinstance(
+                    deadline_ms, (int, float)
+                ):
+                    # validate client input here: a str would only blow
+                    # up as TypeError inside submit's deadline math,
+                    # misclassifying a bad request as a 500
+                    raise ValueError(
+                        f"deadline_ms must be a number, got {deadline_ms!r}"
+                    )
+                y = server.predict(
+                    name,
+                    request["x"],
+                    deadline_ms=deadline_ms,
+                )
+                self._reply(200, {"y": y.tolist()})
+            except ServerOverloadedError as e:
+                self._reply(
+                    429, {"error": type(e).__name__, "message": str(e)}
+                )
+            except (TimeoutError, FutureTimeoutError) as e:
+                # DeadlineExceededError subclasses TimeoutError; the
+                # second class is Future.result's py3.10 timeout for a
+                # request stuck behind a deep queue — a handler must
+                # always answer, never drop the connection
+                self._reply(
+                    504, {"error": type(e).__name__, "message": str(e)}
+                )
+            except (ConfigurationError, KeyError, ValueError,
+                    json.JSONDecodeError) as e:
+                self._reply(
+                    400, {"error": type(e).__name__, "message": str(e)}
+                )
+            except Exception as e:  # noqa: BLE001 — an eval failure
+                # propagates the typed root cause through the request
+                # Future; answering 500 (instead of letting the
+                # handler abort and drop the keep-alive socket) keeps
+                # the always-answer contract for unforeseen classes too
+                self._reply(
+                    500, {"error": type(e).__name__, "message": str(e)}
+                )
+
+    return Handler
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="blitzen", description=__doc__)
+    parser.add_argument(
+        "models", nargs="+",
+        help="name=path.onnx (bare paths name themselves by stem)",
+    )
+    parser.add_argument(
+        "--features", action="append", default=[], metavar="NAME=N",
+        help="per-model row feature count (repeatable)",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument(
+        "--max-batch", type=int, default=None,
+        help="largest coalesced batch / padding bucket "
+        "(MOOSE_TPU_SERVE_MAX_BATCH)",
+    )
+    parser.add_argument(
+        "--max-wait-ms", type=float, default=None,
+        help="batch hold time (MOOSE_TPU_SERVE_MAX_WAIT_MS)",
+    )
+    parser.add_argument(
+        "--queue-bound", type=int, default=None,
+        help="pending-request bound per model (MOOSE_TPU_SERVE_QUEUE)",
+    )
+    parser.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="default per-request deadline (MOOSE_TPU_SERVE_DEADLINE_MS)",
+    )
+    parser.add_argument(
+        "--oneshot", default=None, metavar="JSON",
+        help='evaluate one {"model": ..., "x": [[...]]} request and '
+        "print the result instead of serving (smoke/docs)",
+    )
+    args = parser.parse_args(argv)
+
+    model_paths = parse_models(args.models)
+    row_features = {}
+    for spec in args.features:
+        name, sep, value = spec.partition("=")
+        if not sep or not value.strip():
+            raise SystemExit(
+                f"--features expects NAME=N, got {spec!r}"
+            )
+        row_features[name.strip()] = value.strip()
+    unknown = sorted(set(row_features) - set(model_paths))
+    if unknown:
+        # a typo'd NAME would otherwise be dropped silently and the
+        # model fall back to ONNX shape inference
+        raise SystemExit(
+            f"--features names no registered model: {unknown}; "
+            f"models: {sorted(model_paths)}"
+        )
+    server = build_server(model_paths, row_features, args)
+
+    if args.oneshot is not None:
+        request = json.loads(args.oneshot)
+        model_name = request.get("model") or next(iter(model_paths))
+        y = server.predict(model_name, request["x"])
+        print(json.dumps({"y": y.tolist()}))
+        server.close()
+        return
+
+    from http.server import ThreadingHTTPServer
+
+    httpd = ThreadingHTTPServer(
+        (args.host, args.port), _make_handler(server)
+    )
+    print(
+        f"blitzen: serving {server.registry.names()} on "
+        f"http://{args.host}:{args.port} "
+        f"(max_batch={server.config.max_batch}, "
+        f"max_wait_ms={server.config.max_wait_ms}, "
+        f"queue_bound={server.config.queue_bound})",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,23 @@ class ConfigurationError(MooseError, ValueError):
     """Invalid runtime/session configuration."""
 
 
+class ServerOverloadedError(MooseError):
+    """The serving layer's bounded request queue is full (admission
+    control, ``moose_tpu/serving``): the request was REJECTED, not
+    queued.  Raised synchronously at submit time so callers shed load
+    instead of hanging; retryable by the taxonomy — backing off and
+    resubmitting can succeed once the queue drains."""
+
+
+class DeadlineExceededError(MooseError, TimeoutError):
+    """A serving request's deadline expired before its result was
+    produced.  Requests already expired when their batch is assembled
+    are dropped WITHOUT being evaluated (an expired request never
+    occupies batch rows); requests that expire mid-evaluation surface
+    this error after the fact and count as a deadline miss in serving
+    telemetry."""
+
+
 # ---------------------------------------------------------------------------
 # Typed wire errors: structured envelopes for the distributed runtime.
 #
@@ -121,7 +138,9 @@ def is_retryable(exc: BaseException) -> bool:
     surface immediately."""
     if isinstance(exc, _PERMANENT_NETWORKING):
         return False
-    return isinstance(exc, (NetworkingError, SessionAbortedError))
+    return isinstance(
+        exc, (NetworkingError, SessionAbortedError, ServerOverloadedError)
+    )
 
 
 def _class_registry() -> dict:

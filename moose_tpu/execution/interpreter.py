@@ -65,6 +65,56 @@ def _is_static_scalar(ty_name: str) -> bool:
     return ty_name in ("HostInt", "HostFloat", "HostString")
 
 
+def master_key_words(domain: str = "") -> np.ndarray:
+    """The per-evaluation 128-bit master key as four uint32 words.
+
+    Normally drawn from local entropy (each evaluation gets fresh
+    masks).  Under ``MOOSE_TPU_FIXED_KEYS`` (TEST-ONLY, gated exactly
+    like the worker's PrfKeyGen knob: replicated fixed-point results
+    carry ±1 LSB of share-dependent truncation noise, so bit-exactness
+    tests — chaos replay, serving batch-scatter — need reproducible
+    keys) the key derives deterministically from the knob value and
+    ``domain``.  A real deployment must never run with derivable keys,
+    hence the MOOSE_TPU_ALLOW_WEAK_PRF=1 requirement."""
+    import os
+
+    fixed = os.environ.get("MOOSE_TPU_FIXED_KEYS")
+    if fixed:
+        if os.environ.get("MOOSE_TPU_ALLOW_WEAK_PRF") != "1":
+            from ..errors import ConfigurationError
+
+            raise ConfigurationError(
+                "MOOSE_TPU_FIXED_KEYS is a testing knob and requires "
+                "MOOSE_TPU_ALLOW_WEAK_PRF=1 — fixed PRF keys void all "
+                "inter-party secrecy"
+            )
+        import hashlib
+
+        digest = hashlib.blake2b(
+            f"{fixed}|{domain}".encode(), digest_size=16
+        ).digest()
+        return np.frombuffer(digest, dtype=np.uint32)
+    return np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
+
+
+def _fixed_sync_seed() -> Optional[int]:
+    """Philox seed pinning the logical dialect's trace-time sync-key
+    nonces under MOOSE_TPU_FIXED_KEYS (physical plans bake sync keys as
+    graph attributes and need no pinning).  None when the knob is off —
+    nonces then come from OS entropy as usual."""
+    import os
+
+    fixed = os.environ.get("MOOSE_TPU_FIXED_KEYS")
+    if not fixed:
+        return None
+    import hashlib
+
+    digest = hashlib.blake2b(
+        f"{fixed}|sync".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "little")
+
+
 def _fault_kinds() -> frozenset:
     """Op kinds listed in MOOSE_TPU_SELFCHECK_FAULT (comma-separated):
     the self-check runners corrupt those ops' results in their JIT
@@ -351,13 +401,21 @@ class _PerOpPlan:
 
     Boundary and static ops (Input/Load/Save/Output, baked constants,
     key feeds) always run eagerly — host-boundary work with nothing to
-    fuse — and are not counted as "pinned"."""
+    fuse — and are not counted as "pinned".
+
+    ``seg_size`` generalizes the rung to coarser granularity: plans too
+    large for one-program-per-op validation (above MOOSE_TPU_PEROP_MAX)
+    validate and pin ``seg_size``-op CHUNKS instead, so exhausting the
+    segment rungs lands on mostly-jitted execution with only the
+    divergent chunks eager rather than pinning the whole plan (a pinned
+    chunk is identified by its first op's name)."""
 
     def __init__(self, order, static_env, dynamic_names, effective_inputs,
                  seg_exec, fault_kinds, rand_slice, always_eager=(),
-                 seg_invoke=None, pinned=()):
+                 seg_invoke=None, pinned=(), seg_size: int = 1):
+        self.seg_size = max(1, seg_size)
         chunks, in_names, out_names = plan_segments(
-            order, static_env, effective_inputs, 1
+            order, static_env, effective_inputs, self.seg_size
         )
         self._chunks = chunks
         self._in_names = in_names
@@ -372,8 +430,12 @@ class _PerOpPlan:
         self._rand_slice = rand_slice
         self._seg_invoke = seg_invoke
         self._always = set(always_eager) | set(static_env)
+        # a chunk is validatable when ANY of its ops does real compute
+        # (a seg_size>1 chunk may open with a boundary op yet still
+        # carry kernels worth jitting)
         self._validatable = frozenset(
-            names[0] for names in chunks if names[0] not in self._always
+            names[0] for names in chunks
+            if any(n not in self._always for n in names)
         )
         # seeding from a previous runner's pins (the plan registry) lets
         # promotion survive across runtimes without re-diverging first
@@ -482,7 +544,10 @@ class _PerOpPlan:
         outputs: dict[str, Any] = {}
         saves: dict[tuple[str, str], Any] = {}
         for si, names in enumerate(self._chunks):
-            eager = names[0] in self._always or names[0] in self.pinned
+            eager = (
+                names[0] not in self._validatable
+                or names[0] in self.pinned
+            )
             fn = self._eager_fns[si] if eager else self._jit_fn(si)
             self._merge(env, outputs, saves,
                         self._call(si, fn, rand, dyn, env))
@@ -635,10 +700,12 @@ class _SelfCheckBase:
         from ..logger import get_logger
 
         self._level += 1
+        per_op_skipped = False
         while self._level < len(self.LADDER):
             rung = self.LADDER[self._level]
             self._build_candidate()
             if rung is _PER_OP and self._per_op is None:
+                per_op_skipped = True
                 self._level += 1
                 continue
             get_logger().warning(
@@ -652,8 +719,11 @@ class _SelfCheckBase:
             self._save_state()
             return
         get_logger().warning(
-            "jit self-check: every rung diverged; plan pinned to eager "
-            "execution"
+            "jit self-check: every ladder rung (segment sizes and the "
+            "per-op rung%s) diverged; plan pinned to whole-plan eager "
+            "execution",
+            " — skipped: disabled or above MOOSE_TPU_PEROP_MAX"
+            if per_op_skipped else "",
         )
         self.mode = "eager"
         self._jit_fn = None
@@ -689,8 +759,10 @@ class _SelfCheckBase:
             self._checks_left -= 1
         if self._per_op.all_pinned():
             get_logger().warning(
-                "per-op jit self-check: every op diverged; plan pinned "
-                "to eager execution"
+                "per-op jit self-check: every %s diverged; plan pinned "
+                "to eager execution",
+                "op" if self._per_op.seg_size == 1
+                else f"{self._per_op.seg_size}-op chunk",
             )
             self.mode = "eager"
             self._per_op = None
@@ -1306,10 +1378,20 @@ class Interpreter:
                         val = np.asarray(val)
                     dyn[name] = _device_cache.put(val)
 
-        master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
+        master_key = master_key_words("logical")
+        import contextlib
+
+        from ..dialects import host
+
+        sync_seed = _fixed_sync_seed()
+        sync_ctx = (
+            host.deterministic_sync_keys(sync_seed)
+            if sync_seed is not None
+            else contextlib.nullcontext()
+        )
         # the span covers output materialization as well — jit dispatch is
         # async, so timing the call alone would under-measure
-        with telemetry.span("execute", jit=plan.use_jit) as sp:
+        with telemetry.span("execute", jit=plan.use_jit) as sp, sync_ctx:
             outputs, saves = fn(master_key, dyn)
             # plan shape AFTER the run: a validating evaluation may have
             # promoted/demoted/pinned during the call

@@ -40,7 +40,16 @@ from ..values import (
 from .session import EagerSession
 
 
-def _fresh_key_words() -> np.ndarray:
+def _fresh_key_words(domain: str = "") -> np.ndarray:
+    """Fresh 128-bit key words; under MOOSE_TPU_FIXED_KEYS (test-only,
+    gated — see interpreter.master_key_words) derived from ``domain``
+    (the key op's name) so lowered-plan evaluations are reproducible."""
+    import os
+
+    if os.environ.get("MOOSE_TPU_FIXED_KEYS"):
+        from .interpreter import master_key_words
+
+        return master_key_words(f"physical|{domain}")
     return np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
 
 
@@ -91,9 +100,10 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
     if kind == "PrfKeyGen":
         # normally handled by the plan (keys enter as runtime inputs so the
         # jitted program stays reusable); eager fallback for direct calls
+        # (domain = op name so fixed-keys mode gives DISTINCT keys per op)
         import jax.numpy as jnp
 
-        return HostPrfKey(jnp.asarray(_fresh_key_words()), plc)
+        return HostPrfKey(jnp.asarray(_fresh_key_words(op.name)), plc)
     if kind == "DeriveSeed":
         return sess.derive_seed(plc, args[0], A["sync_key"])
     if kind == "SampleSeeded":
@@ -104,7 +114,7 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
         # (_run_physical_ops)
         import jax.numpy as jnp
 
-        seed = HostSeed(jnp.asarray(_fresh_key_words()), plc)
+        seed = HostSeed(jnp.asarray(_fresh_key_words(op.name)), plc)
         return _sample_from_seed(sess, plc, args[0], seed, ret.name, A)
     if kind == "Add":
         return sess.add(plc, args[0], args[1])
@@ -504,11 +514,24 @@ def _physical_per_op_builder(comp, arguments, eager_plan, fault_kinds,
     compose exactly like segments do."""
     import weakref
 
-    from .interpreter import _per_op_limit, _PerOpPlan
+    from .interpreter import _per_op_limit, _PerOpPlan, _SelfCheckBase
 
     order, key_ops, dyn_names, static_env, _ = eager_plan
-    if len(order) > _per_op_limit():
+    limit = _per_op_limit()
+    if limit <= 0:
         return None
+    seg_size = 1
+    if len(order) > limit:
+        # Too many ops for one-program-per-op validation (the cap bounds
+        # how many tiny XLA programs the rung may compile).  Physical
+        # plans are deterministic given their key dict, so the rung
+        # still applies at coarser granularity: validate and pin
+        # ``seg_size``-op CHUNKS — at least the ladder's finest segment
+        # rung, grown until the chunk count fits the cap.  A bench-scale
+        # lowered predictor (~10k host ops) lands here with only its
+        # divergent chunks eager instead of the whole plan.
+        finest = _SelfCheckBase.LADDER[-2]
+        seg_size = max(finest, -(-len(order) // limit))
     comp_ref = weakref.ref(comp)
     recv_src = _recv_sources(comp, order)
     key_set = set(key_ops)
@@ -534,13 +557,17 @@ def _physical_per_op_builder(comp, arguments, eager_plan, fault_kinds,
         n for n in order
         if comp.operations[n].kind in _PER_OP_EAGER_KINDS
     }
+    # chunking mirrors _PerOpPlan's own (consecutive seg_size slices of
+    # the same order), so per-chunk key narrowing stays aligned
+    keys_of = [
+        [n for n in order[i:i + seg_size] if n in key_set]
+        for i in range(0, len(order), seg_size)
+    ]
     return _PerOpPlan(
         order, static_env, dyn_names, effective_inputs, seg_exec,
         fault_kinds,
-        lambda keys, si: (
-            {order[si]: keys[order[si]]} if order[si] in key_set else {}
-        ),
-        always_eager=always, pinned=pinned,
+        lambda keys, si: {n: keys[n] for n in keys_of[si]},
+        always_eager=always, pinned=pinned, seg_size=seg_size,
     )
 
 
@@ -641,7 +668,7 @@ class PhysicalInterpreter:
 
         from .. import telemetry
 
-        keys = {n: _fresh_key_words() for n in key_ops}
+        keys = {n: _fresh_key_words(n) for n in key_ops}
         with telemetry.span("execute", jit=use_jit) as sp:
             outputs, saves = fn(keys, dyn)
             # plan shape AFTER the run: a validating evaluation may have

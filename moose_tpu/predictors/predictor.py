@@ -52,6 +52,15 @@ class Predictor(metaclass=abc.ABCMeta):
         self.alice, self.bob, self.carole = ctx.players
         self.replicated = ctx.replicated
         self.mirrored = ctx.mirrored
+        # re-trace memoization: predictor_factory used to build a FRESH
+        # AbstractComputation per call, so every runtime missed its
+        # weak-keyed trace/plan caches and re-traced the identical
+        # graph.  Keyed by (factory kind, fixedpoint dtype); per-batch-
+        # bucket compiled plans then come free from the runtimes' plan
+        # caches, which key on the (stable) computation object plus the
+        # argument shapes.  The serving registry builds on this same
+        # cache.
+        self._factory_cache = {}
 
     @property
     def host_placements(self):
@@ -74,19 +83,52 @@ class Predictor(metaclass=abc.ABCMeta):
         with prediction_handler:
             return pm.cast(prediction, dtype=output_dtype)
 
+    def _memoized(self, key, build):
+        """Instance-level factory/trace memo (subclasses that skip
+        ``Predictor.__init__`` get a lazily-created dict)."""
+        cache = getattr(self, "_factory_cache", None)
+        if cache is None:
+            cache = self._factory_cache = {}
+        value = cache.get(key)
+        if value is None:
+            value = cache[key] = build()
+        return value
+
     def predictor_factory(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
         """Standard plaintext-input computation: alice supplies x, bob
-        receives the prediction; the model itself runs replicated."""
+        receives the prediction; the model itself runs replicated.
 
-        @pm.computation
-        def predictor(x: pm.Argument(self.alice, dtype=pm.float64)):
-            with self.alice:
-                x_fixed = pm.cast(x, dtype=fixedpoint_dtype)
-            with self.replicated:
-                y = self(x_fixed, fixedpoint_dtype)
-            return self.handle_output(y, prediction_handler=self.bob)
+        Memoized per (predictor instance, fixedpoint dtype): repeated
+        calls return the SAME AbstractComputation, so runtimes hit
+        their weak-keyed trace and plan caches instead of re-tracing —
+        per batch-bucket plans are cached downstream by argument
+        shape."""
 
-        return predictor
+        def build():
+            @pm.computation
+            def predictor(x: pm.Argument(self.alice, dtype=pm.float64)):
+                with self.alice:
+                    x_fixed = pm.cast(x, dtype=fixedpoint_dtype)
+                with self.replicated:
+                    y = self(x_fixed, fixedpoint_dtype)
+                return self.handle_output(y, prediction_handler=self.bob)
+
+            return predictor
+
+        return self._memoized(("plain", fixedpoint_dtype), build)
+
+    def traced_predictor(self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE):
+        """The TRACED logical computation of :meth:`predictor_factory`,
+        memoized alongside it: registration-time consumers (the serving
+        model registry) trace once per (instance, dtype) and every
+        runtime/bucket reuses the same Computation object."""
+
+        def build():
+            from ..edsl import tracer
+
+            return tracer.trace(self.predictor_factory(fixedpoint_dtype))
+
+        return self._memoized(("traced", fixedpoint_dtype), build)
 
     def _standard_replicated_placements(self):
         # kept for API compatibility with reference-era subclasses that
@@ -123,22 +165,29 @@ class AesInputMixin:
     def aes_predictor_factory(
         self, fixedpoint_dtype=utils.DEFAULT_FIXED_DTYPE
     ):
-        @pm.computation
-        def predictor(
-            aes_data: pm.Argument(
-                self.alice,
-                vtype=pm.AesTensorType(dtype=fixedpoint_dtype),
-            ),
-            aes_key: pm.Argument(self.replicated, vtype=pm.AesKeyType()),
-        ):
-            x = self.handle_aes_input(
-                aes_key, aes_data, decryptor=self.replicated
-            )
-            with self.replicated:
-                pred = self.predictor_fn(x, fixedpoint_dtype)
-            return self.handle_output(pred, prediction_handler=self.bob)
+        def build():
+            @pm.computation
+            def predictor(
+                aes_data: pm.Argument(
+                    self.alice,
+                    vtype=pm.AesTensorType(dtype=fixedpoint_dtype),
+                ),
+                aes_key: pm.Argument(
+                    self.replicated, vtype=pm.AesKeyType()
+                ),
+            ):
+                x = self.handle_aes_input(
+                    aes_key, aes_data, decryptor=self.replicated
+                )
+                with self.replicated:
+                    pred = self.predictor_fn(x, fixedpoint_dtype)
+                return self.handle_output(
+                    pred, prediction_handler=self.bob
+                )
 
-        return predictor
+            return predictor
+
+        return self._memoized(("aes", fixedpoint_dtype), build)
 
 
 def AesWrapper(inner_model_cls):

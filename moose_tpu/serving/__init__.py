@@ -1,0 +1,49 @@
+"""Secure inference serving: warm model registry + dynamic micro-batching.
+
+The single-request user path pays trace + compile + self-check-ladder
+cost per call and runs batches of one; the TPU path is ~an order of
+magnitude faster at the batch sizes XLA fuses well (BENCH_r05: logreg
+~9070 infer/s at batch 1024 vs ~1191 single-request).  This subsystem
+closes that gap for serving traffic:
+
+- :mod:`registry` — traces a predictor once per (model, fixedpoint
+  dtype), compiles each batch bucket through the existing pipeline, and
+  drives the validated-jit ladder to steady state at REGISTRATION time,
+  so requests never pay trace/compile/ladder cost;
+- :mod:`batcher` — per-model bounded queues; the scheduler coalesces
+  pending requests up to ``max_batch`` rows or ``max_wait_ms``
+  (whichever first), pads to power-of-two buckets (no recompiles),
+  evaluates once, scatters per-row results to callers, and enforces
+  deadlines + typed ``ServerOverloadedError`` backpressure;
+- :mod:`server` — the in-process :class:`InferenceServer` API (the
+  ``blitzen`` CLI daemon wraps it with an HTTP front end);
+- :mod:`metrics` — queue depth, batch-size histogram, batch-fill ratio,
+  p50/p99 request latency, deadline misses, plus the warm-path
+  acceptance counters (no re-trace / no ladder re-run after warmup).
+
+Knobs: ``MOOSE_TPU_SERVE_MAX_BATCH`` / ``MOOSE_TPU_SERVE_MAX_WAIT_MS``
+/ ``MOOSE_TPU_SERVE_QUEUE`` / ``MOOSE_TPU_SERVE_DEADLINE_MS`` (see
+:mod:`config`).
+"""
+
+from .config import ServingConfig
+from .metrics import ServingMetrics
+from .registry import (
+    ModelRegistry,
+    RegisteredModel,
+    bucket_for,
+    power_of_two_buckets,
+)
+from .batcher import ModelQueue
+from .server import InferenceServer
+
+__all__ = [
+    "InferenceServer",
+    "ModelQueue",
+    "ModelRegistry",
+    "RegisteredModel",
+    "ServingConfig",
+    "ServingMetrics",
+    "bucket_for",
+    "power_of_two_buckets",
+]

@@ -1,0 +1,122 @@
+"""Serving telemetry: aggregate counters + per-request latency quantiles.
+
+Every dispatched batch emits a ``serve_batch`` span through the existing
+``telemetry`` module (queue depth, batch size, bucket, fill ratio, plan
+state as span attrs — so OTLP export and ``MOOSE_TPU_TRACE=1`` work
+unchanged); this module keeps the cheap always-on aggregates a serving
+loop needs without retaining span trees: batch-size histogram, batch
+fill ratio, p50/p99 request latency, deadline misses, and admission
+rejections.  The two ``*_after_warm`` counters are the acceptance hook
+for the warm registry: a registered model must never re-trace or re-run
+the validated-jit ladder once registration finished, so both stay 0 in
+a healthy server (bench.py and scripts/serve_smoke.py assert this).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+
+def _quantile(sorted_values, q: float) -> Optional[float]:
+    if not sorted_values:
+        return None
+    # nearest-rank with rounding UP: a flooring index would report the
+    # MINIMUM as "p99" for small samples (int(0.99 * 1) == 0)
+    idx = min(len(sorted_values) - 1, math.ceil(q * len(sorted_values)) - 1)
+    return sorted_values[max(0, idx)]
+
+
+class ServingMetrics:
+    """Thread-safe aggregate serving counters (one instance per
+    :class:`~moose_tpu.serving.server.InferenceServer`)."""
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self.batches = 0
+        self.rows_served = 0
+        self.fill_sum = 0.0  # sum of rows/bucket over batches
+        self.batch_size_hist: Dict[int, int] = {}
+        self.deadline_misses = 0  # results delivered after their deadline
+        self.deadline_drops = 0  # expired before dispatch, never batched
+        self.overloads = 0  # submissions rejected by admission control
+        self.eval_failures = 0
+        # acceptance counters: both must stay 0 after registration
+        self.retraces_after_warm = 0
+        self.validating_after_warm = 0
+        # most recent request latencies (seconds), bounded
+        self._latencies = deque(maxlen=latency_window)
+
+    def record_batch(self, rows: int, bucket: int, retraced: bool,
+                     validating: bool) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows_served += rows
+            self.fill_sum += rows / float(bucket)
+            self.batch_size_hist[bucket] = (
+                self.batch_size_hist.get(bucket, 0) + 1
+            )
+            if retraced:
+                self.retraces_after_warm += 1
+            if validating:
+                self.validating_after_warm += 1
+
+    def record_latency(self, seconds: float, missed_deadline: bool) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+            if missed_deadline:
+                self.deadline_misses += 1
+
+    def record_deadline_drop(self) -> None:
+        with self._lock:
+            self.deadline_drops += 1
+
+    def record_overload(self) -> None:
+        with self._lock:
+            self.overloads += 1
+
+    def record_eval_failure(self) -> None:
+        with self._lock:
+            self.eval_failures += 1
+
+    def reset_window(self) -> None:
+        """Zero the traffic aggregates (batches, fill, histogram,
+        latencies, misses/drops/overloads) so a measurement window
+        starts clean — e.g. bench snapshots after a warm-up loop.  The
+        ``*_after_warm`` acceptance counters are NOT reset: they must
+        hold over the server's whole post-registration lifetime."""
+        with self._lock:
+            self.batches = 0
+            self.rows_served = 0
+            self.fill_sum = 0.0
+            self.batch_size_hist = {}
+            self.deadline_misses = 0
+            self.deadline_drops = 0
+            self.overloads = 0
+            self.eval_failures = 0
+            self._latencies.clear()
+
+    def snapshot(self) -> dict:
+        """One JSON-able dict of every aggregate (the ``blitzen``
+        ``/v1/metrics`` payload and the bench/smoke assertion surface)."""
+        with self._lock:
+            lat = sorted(self._latencies)
+            batches = self.batches
+            return {
+                "batches": batches,
+                "rows_served": self.rows_served,
+                "batch_fill_ratio": (
+                    self.fill_sum / batches if batches else None
+                ),
+                "batch_size_hist": dict(self.batch_size_hist),
+                "request_latency_p50_s": _quantile(lat, 0.50),
+                "request_latency_p99_s": _quantile(lat, 0.99),
+                "deadline_misses": self.deadline_misses,
+                "deadline_drops": self.deadline_drops,
+                "overloads": self.overloads,
+                "eval_failures": self.eval_failures,
+                "retraces_after_warm": self.retraces_after_warm,
+                "validating_after_warm": self.validating_after_warm,
+            }

@@ -1,0 +1,283 @@
+"""Warm model registry: trace/compile/validate once, serve forever.
+
+A registered model pays the full per-plan cost exactly once, at
+registration time, per (model, batch-bucket) pair:
+
+- the predictor is traced ONCE (``Predictor.traced_predictor`` memoizes
+  the traced Computation per (instance, fixedpoint dtype) — the same
+  cache ``predictor_factory`` users hit outside the server);
+- each batch bucket's plan compiles through the existing pipeline (the
+  runtime's weak-keyed plan caches, keyed on the stable computation
+  object + argument shapes);
+- the PR-2 validated-jit self-check ladder is DRIVEN TO STEADY STATE
+  with warmup evaluations, so no serving request ever lands on a
+  validating (eager-reference-paying) evaluation;
+
+after which requests only ever pay the resolved plan's execution cost.
+Bucket policy: powers of two up to ``max_batch`` (padding a ragged
+batch to the next bucket re-uses a warm plan instead of recompiling for
+every distinct batch size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..errors import ConfigurationError
+
+
+def power_of_two_buckets(max_batch: int) -> Tuple[int, ...]:
+    """(1, 2, 4, ..., max_batch) — a non-power-of-two max_batch rounds
+    UP so a full ``max_batch``-row batch is always servable, at the cost
+    of one extra-large warm plan and up to 2x padding on batches above
+    the previous power of two.  Pass an explicit ``buckets=`` ladder to
+    ``register_model`` to opt out."""
+    if max_batch < 1:
+        raise ConfigurationError(f"max_batch must be >= 1, got {max_batch}")
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(b)
+    return tuple(buckets)
+
+
+def bucket_for(rows: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest registered bucket holding ``rows`` rows."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    raise ConfigurationError(
+        f"batch of {rows} rows exceeds the largest bucket {buckets[-1]}"
+    )
+
+
+@dataclasses.dataclass
+class RegisteredModel:
+    """One warm model: the traced computation plus everything needed to
+    evaluate a padded bucket without re-tracing or re-validating."""
+
+    name: str
+    comp: object  # traced Computation (held strongly: keys weak caches)
+    input_name: str
+    row_shape: Tuple[int, ...]  # per-row trailing shape
+    buckets: Tuple[int, ...]
+    warmup_report: Dict[int, dict]  # bucket -> {evals, plan_state, ...}
+
+    def pad(self, rows: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Zero-pad a (n, *row_shape) batch up to its bucket."""
+        n = rows.shape[0]
+        bucket = bucket_for(n, self.buckets)
+        if n == bucket:
+            return rows, bucket
+        padded = np.zeros((bucket, *rows.shape[1:]), dtype=rows.dtype)
+        padded[:n] = rows
+        return padded, bucket
+
+
+class ModelRegistry:
+    """Registry of warm models over one shared runtime.
+
+    The runtime is single-flight by design (one XLA program executes at
+    a time; plan caches are plain dicts): every evaluation — warmup and
+    serving alike — runs under ``eval_lock``, which the micro-batch
+    schedulers share."""
+
+    def __init__(self, runtime=None, config=None):
+        if runtime is None:
+            from ..runtime import LocalMooseRuntime
+
+            runtime = LocalMooseRuntime(["alice", "bob", "carole"])
+        if config is None:
+            from .config import ServingConfig
+
+            config = ServingConfig.from_env()
+        self.runtime = runtime
+        self.config = config
+        self.eval_lock = threading.Lock()
+        self._models: Dict[str, RegisteredModel] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._models
+
+    def get(self, name: str) -> RegisteredModel:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise ConfigurationError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._models)}"
+            ) from None
+
+    def names(self):
+        return sorted(self._models)
+
+    def register(
+        self,
+        name: str,
+        model,
+        row_shape: Tuple[int, ...],
+        buckets: Tuple[int, ...] = (),
+        fixedpoint_dtype=None,
+        input_name: Optional[str] = None,
+        max_warmup_evals: int = 12,
+    ) -> RegisteredModel:
+        """Trace, compile, and ladder-validate ``model`` for every batch
+        bucket; returns the warm :class:`RegisteredModel`.
+
+        ``model`` is a ``predictors.Predictor`` (traced via its memoized
+        ``traced_predictor``), an ``AbstractComputation``, or an
+        already-traced ``Computation``.  ``row_shape`` is the per-row
+        input shape (e.g. ``(n_features,)``).  Each bucket is warmed
+        until the runtime reports a non-``validating`` plan state, so
+        serving traffic never executes a ladder step."""
+        if name in self._models:
+            raise ConfigurationError(f"model {name!r} already registered")
+        with telemetry.span("register_model", model=name) as root:
+            comp = self._resolve(model, fixedpoint_dtype)
+            self._check_single_output(comp)
+            input_name = input_name or self._input_name(comp)
+            if not buckets:
+                buckets = power_of_two_buckets(self.config.max_batch)
+            buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if buckets[0] < 1:
+                # an explicit 0/negative bucket would warm a degenerate
+                # shape and then reject every request at admission
+                raise ConfigurationError(
+                    f"buckets must all be >= 1, got {buckets}"
+                )
+            warmup_report: Dict[int, dict] = {}
+            for bucket in buckets:
+                warmup_report[bucket] = self._warm_bucket(
+                    comp, input_name, bucket, row_shape, max_warmup_evals
+                )
+            root.attrs["buckets"] = list(buckets)
+            root.attrs["warmup_evals"] = sum(
+                r["evals"] for r in warmup_report.values()
+            )
+        registered = RegisteredModel(
+            name=name,
+            comp=comp,
+            input_name=input_name,
+            row_shape=tuple(row_shape),
+            buckets=buckets,
+            warmup_report=warmup_report,
+        )
+        self._models[name] = registered
+        return registered
+
+    def evaluate(self, model: RegisteredModel, batch: np.ndarray):
+        """One warm evaluation of a full (already padded) bucket.
+        Returns (per-row outputs, eval_report) where the report carries
+        the re-trace / ladder-state acceptance bits."""
+        with self.eval_lock:
+            outputs = self.runtime.evaluate_computation(
+                model.comp, arguments={model.input_name: batch}
+            )
+            if isinstance(outputs, tuple):  # GrpcMooseRuntime returns
+                outputs = outputs[0]  # (outputs, per-role timings)
+            timings = getattr(self.runtime, "last_timings", {})
+            plan_state = getattr(self.runtime, "last_plan", {}).get(
+                "plan_state"
+            )
+        (result,) = outputs.values()
+        return np.asarray(result), {
+            # a warm evaluation re-entering the tracer means the
+            # registry's central promise broke — surfaced per batch
+            "retraced": "trace" in timings,
+            "plan_state": plan_state,
+            "validating": plan_state == "validating",
+        }
+
+    # -- internals ---------------------------------------------------------
+
+    def _resolve(self, model, fixedpoint_dtype):
+        from ..computation import Computation
+        from ..edsl import base as edsl_base
+        from ..edsl import tracer
+
+        if isinstance(model, Computation):
+            return model
+        if isinstance(model, edsl_base.AbstractComputation):
+            with telemetry.span("trace"):
+                return tracer.trace(model)
+        if hasattr(model, "traced_predictor"):
+            kwargs = (
+                {"fixedpoint_dtype": fixedpoint_dtype}
+                if fixedpoint_dtype is not None
+                else {}
+            )
+            with telemetry.span("trace"):
+                return model.traced_predictor(**kwargs)
+        raise ConfigurationError(
+            "model must be a Predictor, AbstractComputation, or "
+            f"Computation, found {type(model)}"
+        )
+
+    @staticmethod
+    def _input_name(comp) -> str:
+        inputs = [
+            n for n, op in comp.operations.items() if op.kind == "Input"
+        ]
+        if len(inputs) != 1:
+            raise ConfigurationError(
+                "serving requires a single-Input computation (pass "
+                f"input_name= to disambiguate); found {sorted(inputs)}"
+            )
+        return inputs[0]
+
+    @staticmethod
+    def _check_single_output(comp) -> None:
+        # the scatter path slices ONE per-row result tensor; reject
+        # multi-output graphs at registration (even when input_name= is
+        # passed explicitly) instead of failing every request with an
+        # unpacking error at serve time
+        outputs = [
+            n for n, op in comp.operations.items() if op.kind == "Output"
+        ]
+        if len(outputs) != 1:
+            raise ConfigurationError(
+                "serving requires a single-Output computation; found "
+                f"{sorted(outputs)}"
+            )
+
+    def _warm_bucket(self, comp, input_name, bucket, row_shape,
+                     max_warmup_evals) -> dict:
+        """Compile + drive the self-check ladder to steady state for one
+        bucket shape.  Warmup rows are random (not zeros): validating
+        evaluations compare jit against eager bit-for-bit, and a
+        degenerate all-zero operand would under-exercise the kernels
+        being validated."""
+        rng = np.random.default_rng(bucket)
+        x = rng.normal(size=(bucket, *row_shape))
+        with telemetry.span("warm_bucket", bucket=bucket) as sp:
+            evals = 0
+            plan_state = None
+            for _ in range(max(1, max_warmup_evals)):
+                with self.eval_lock:
+                    self.runtime.evaluate_computation(
+                        comp, arguments={input_name: x}
+                    )
+                    plan_state = getattr(
+                        self.runtime, "last_plan", {}
+                    ).get("plan_state")
+                evals += 1
+                if plan_state != "validating":
+                    break
+            sp.attrs["evals"] = evals
+            sp.attrs["plan_state"] = str(plan_state)
+        if plan_state == "validating":
+            from ..logger import get_logger
+
+            get_logger().warning(
+                "bucket %d still validating after %d warmup evaluations;"
+                " serving traffic will finish driving the ladder",
+                bucket, evals,
+            )
+        return {"evals": evals, "plan_state": plan_state}

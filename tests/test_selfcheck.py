@@ -521,3 +521,55 @@ def test_per_op_limit_skips_rung_to_eager(monkeypatch):
     _drive_to_steady_state(runner, dyn, lambda i: _mk(70 + i))
     assert runner.mode == "eager"
     assert runner.exhausted
+
+
+def test_physical_per_op_rung_chunks_above_cap(monkeypatch):
+    """Lowered plans above MOOSE_TPU_PEROP_MAX no longer pin whole-plan
+    eager on ladder exhaustion (the BENCH_r05 tail symptom): the per-op
+    rung falls back to validating/pinning segment-sized CHUNKS, so only
+    the chunks containing the divergent op go eager and the rest stay
+    jitted."""
+    from moose_tpu.execution import physical
+
+    comp, args, want = _lowered_mul_setup()  # 123 ops -> 3 50-op chunks
+    neg_chunk_heads = set()
+    order = comp.toposort_names()
+    for i in range(0, len(order), 50):
+        chunk = order[i:i + 50]
+        if any(comp.operations[n].kind == "Neg" for n in chunk):
+            neg_chunk_heads.add(chunk[0])
+    assert len(neg_chunk_heads) == 1  # the faulted kind sits in 1 chunk
+
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FAULT", "Neg")
+    monkeypatch.setenv("MOOSE_TPU_PEROP_MAX", "10")  # 123 ops > cap
+    runner = interp._SelfCheckRunner(
+        comp, args, checks=1,
+        builder=physical._physical_plan_builder, pin_nonces=False,
+        per_op_builder=physical._physical_per_op_builder,
+        plan_key="physical",
+    )
+    order_, key_ops, dyn_names, static_env, _ = runner.eager_plan
+    dyn = {n: np.asarray(args[n]) for n in dyn_names}
+
+    def keys(i):
+        return {
+            n: np.arange(4, dtype=np.uint32) + 60 + i for n in key_ops
+        }
+
+    _drive_to_steady_state(runner, dyn, keys)
+    # the ladder lands on the (chunked) per-op rung, NOT whole-plan
+    # eager, with exactly the Neg-carrying chunk pinned
+    assert runner.mode == "per-op"
+    assert not runner.exhausted
+    assert runner._per_op.seg_size == 50
+    assert runner.pinned_ops == sorted(neg_chunk_heads)
+    assert not runner._per_op.all_pinned()
+
+    # bit-exactness of the mixed chunked plan vs the all-eager
+    # reference from the SAME keys
+    k = keys(99)
+    mixed = runner.run(k, dyn)
+    ref = runner._eager_fn(k, dyn)
+    assert interp._results_equal(mixed, ref)
+    (val,) = [interp._to_user_value(v) for v in ref[0].values()]
+    np.testing.assert_allclose(np.asarray(val), want, atol=1e-4)
