@@ -107,6 +107,173 @@ def tpu_numerics_check():
     return True
 
 
+def stacked_userpath_numerics_check():
+    """Real-chip numerics gate for the STACKED USER PATH (VERDICT r5
+    Weak #5): a small traced logreg graph (cast -> replicated dot ->
+    protocol sigmoid -> reveal) runs through
+    ``LocalMooseRuntime(layout="stacked")`` at fixed(14,23) AND
+    fixed(24,40) — the precision whose fused sigmoid is the known
+    miscompile reproducer — with the validated-jit ladder driven to
+    steady state, and the RESOLVED plan's outputs verified against
+    numpy.  A ladder regression (wrong promotion, missed pin) then
+    surfaces as ``stacked_userpath_numerics_ok=false`` in the bench
+    JSON instead of a 7 inf/s surprise five stages later."""
+    import moose_tpu as pm
+    from moose_tpu.runtime import LocalMooseRuntime
+
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(8, 6)) * 0.5
+    w = rng.normal(size=(6, 1)) * 0.5
+    want = 1.0 / (1.0 + np.exp(-(x @ w)))
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    for integ, frac in ((14, 23), (24, 40)):
+        fx = pm.fixed(integ, frac)
+
+        @pm.computation
+        def logreg(
+            xa: pm.Argument(placement=alice, dtype=pm.float64),
+            wa: pm.Argument(placement=bob, dtype=pm.float64),
+        ):
+            with alice:
+                xf = pm.cast(xa, dtype=fx)
+            with bob:
+                wf = pm.cast(wa, dtype=fx)
+            with rep:
+                y = pm.sigmoid(pm.dot(xf, wf))
+            with carole:
+                out = pm.cast(y, dtype=pm.float64)
+            return out
+
+        rt = LocalMooseRuntime(
+            ["alice", "bob", "carole"], use_jit=True, layout="stacked"
+        )
+        arguments = {"xa": x, "wa": w}
+        out = next(iter(
+            rt.evaluate_computation(logreg, arguments=arguments).values()
+        ))
+        for _ in range(10):  # drive the ladder to its resolved plan
+            if rt.last_plan.get("plan_state") != "validating":
+                break
+            out = next(iter(
+                rt.evaluate_computation(
+                    logreg, arguments=arguments
+                ).values()
+            ))
+        err = np.abs(np.asarray(out) - want).max()
+        assert err < 5e-3, (
+            f"stacked user-path numerics: fixed({integ},{frac}) "
+            f"err={err} (plan {rt.last_plan})"
+        )
+    return True
+
+
+def bench_distributed_logreg(batch=128, features=100, iters=4,
+                             warm_sessions=12):
+    """ISSUE 5 acceptance metric: 3-worker distributed logreg batch-128
+    inference over local TCP (in-process WorkerServers, real gRPC wire)
+    through the client supervisor.  Measures the compiled worker fast
+    path (MOOSE_TPU_WORKER_JIT=1: per-role validated jit + async
+    coalesced sends + receive prefetch) against the legacy eager
+    scheduler on the same machine and verifies outputs against sklearn.
+    Returns (jit req/s, eager req/s, {party: plan_mode}); the caller
+    records ``distributed_worker_jit_ok`` = every worker settled on a
+    segmented/full-jit plan — a flag, NOT a hard assert, because on
+    real TPU a demoted plan is the self-check catching the known
+    miscompile and the bench must report that as an honest flagged
+    number rather than die (the zero-pin contract on clean CPU graphs
+    is asserted by scripts/dist_smoke.py in CI)."""
+    from sklearn.linear_model import LogisticRegression
+
+    from moose_tpu import predictors
+    from moose_tpu.dialects import ring as ring_dialect
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+    from moose_tpu.edsl import tracer
+    from moose_tpu.predictors.sklearn_export import (
+        logistic_regression_onnx,
+    )
+
+    rng = np.random.default_rng(7)
+    x_train = rng.normal(size=(256, features))
+    y_train = (rng.uniform(size=256) > 0.5).astype(int)
+    sk = LogisticRegression().fit(x_train, y_train)
+    model = predictors.from_onnx(
+        logistic_regression_onnx(sk, features).encode()
+    )
+    traced = tracer.trace(model.predictor_factory())
+    x = rng.normal(size=(batch, features))
+    want = sk.predict_proba(x)
+
+    prev_prf = ring_dialect.get_prf_impl()
+    # workers refuse the non-cryptographic default PRF — threefry is
+    # what a real deployment runs, so it is also what we measure
+    ring_dialect.set_prf_impl("threefry")
+    prev_jit = os.environ.get("MOOSE_TPU_WORKER_JIT")
+
+    def measure(worker_jit: bool):
+        os.environ["MOOSE_TPU_WORKER_JIT"] = "1" if worker_jit else "0"
+        servers = {}
+        try:
+            servers, endpoints = start_local_cluster(
+                ("alice", "bob", "carole")
+            )
+            runtime = GrpcClientRuntime(endpoints)
+            outputs, _ = runtime.run_computation(
+                traced, {"x": x}, timeout=600.0
+            )
+            (got,) = outputs.values()
+            err = np.abs(np.asarray(got) - want).max()
+            assert err < 5e-3, f"distributed logreg mismatch: {err}"
+            modes = {
+                p: m["plan_mode"]
+                for p, m in runtime.last_session_report.get(
+                    "plan_modes", {}
+                ).items()
+            }
+            if worker_jit:
+                # drive every worker's plan to its resolved mode before
+                # timing (validating sessions execute the eager
+                # reference too)
+                for _ in range(warm_sessions):
+                    if all(
+                        m in ("segmented", "full-jit", "eager")
+                        for m in modes.values()
+                    ) and modes:
+                        break
+                    outputs, _ = runtime.run_computation(
+                        traced, {"x": x}, timeout=600.0
+                    )
+                    modes = {
+                        p: m["plan_mode"]
+                        for p, m in runtime.last_session_report.get(
+                            "plan_modes", {}
+                        ).items()
+                    }
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                runtime.run_computation(traced, {"x": x}, timeout=600.0)
+                times.append(time.perf_counter() - t0)
+            return batch / float(np.median(times)), modes
+        finally:
+            for srv in servers.values():
+                srv.stop()
+
+    try:
+        jit_per_sec, modes = measure(True)
+        eager_per_sec, _ = measure(False)
+    finally:
+        ring_dialect.set_prf_impl(prev_prf)
+        if prev_jit is None:
+            os.environ.pop("MOOSE_TPU_WORKER_JIT", None)
+        else:
+            os.environ["MOOSE_TPU_WORKER_JIT"] = prev_jit
+    return jit_per_sec, eager_per_sec, modes
+
+
 def _bench_predictor(comp, args, check, batch, layout=None, iters=5,
                      windows=1, window_gap_s=0.0):
     """Median steady-state latency/throughput of one predictor comp.
@@ -487,6 +654,19 @@ def main():
         print(f"# TPU NUMERICS FAILURE: {type(e).__name__}: {e}")
         tpu_numerics_ok = False
 
+    # stacked USER-PATH numerics gate (VERDICT r5 Weak #5): the traced
+    # logreg graph through the validated-jit ladder at both working
+    # precisions, verified on the real backend before any timing
+    try:
+        stacked_numerics_ok = stacked_userpath_numerics_check()
+    except Exception as e:  # noqa: BLE001 — recorded loudly, never
+        # suppresses the headline record
+        print(
+            f"# STACKED USER-PATH NUMERICS FAILURE: "
+            f"{type(e).__name__}: {e}"
+        )
+        stacked_numerics_ok = False
+
     _, out_dev = fn(mk, da, db)  # compile + first run
     out = np.asarray(out_dev)
     err = np.abs(out - a @ b).max()
@@ -540,6 +720,7 @@ def main():
         "min_s": float(np.min(t_rbg)),
         "n_samples": len(t_rbg),
         "tpu_numerics_ok": tpu_numerics_ok,
+        "stacked_userpath_numerics_ok": stacked_numerics_ok,
         # the baseline ran 3 mutually-distrusting workers over gRPC;
         # this measurement executes the same protocol arithmetic in
         # ONE trust domain (one XLA program, party axis on-mesh)
@@ -627,6 +808,29 @@ def main():
             emit()
     except Exception as e:
         print(f"# serving bench failed: {e}")
+
+    # distributed worker fast path (ISSUE 5): 3-worker logreg batch-128
+    # over local TCP — compiled per-role plans vs the legacy eager
+    # scheduler on the same machine, with per-worker plan modes
+    try:
+        if _within_budget():
+            dist_jit, dist_eager, dist_modes = bench_distributed_logreg()
+            record["distributed_logreg_per_sec"] = dist_jit
+            record["distributed_logreg_eager_per_sec"] = dist_eager
+            record["distributed_worker_jit_speedup"] = (
+                dist_jit / dist_eager if dist_eager else None
+            )
+            record["distributed_plan_modes"] = dist_modes
+            # the acceptance contract as a loud flag: a regression that
+            # demotes any worker to eager/validating shows up here, not
+            # as a quietly-worse throughput number
+            record["distributed_worker_jit_ok"] = bool(dist_modes) and all(
+                m in ("segmented", "full-jit")
+                for m in dist_modes.values()
+            )
+            emit()
+    except Exception as e:
+        print(f"# distributed logreg bench failed: {e}")
 
     # BASELINE.json configs: batch-1024 encrypted inference
     try:

@@ -20,7 +20,11 @@ import argparse
 import json
 import secrets
 import sys
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib
 from pathlib import Path
 
 import numpy as np

@@ -23,6 +23,15 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
 
+    import os
+
+    # one-shot simulation: the worker fast path's validated jit pays
+    # off from the SECOND session of a computation (plan cache), but a
+    # dasher run is exactly one session — validation would compile a
+    # few hundred segment candidates to use each once.  Explicit
+    # MOOSE_TPU_WORKER_JIT=1 still opts in.
+    os.environ.setdefault("MOOSE_TPU_WORKER_JIT", "0")
+
     from moose_tpu.compilation import compile_computation
     from moose_tpu.compilation.lowering import arg_specs_from_arguments
     from moose_tpu.computation import HostPlacement

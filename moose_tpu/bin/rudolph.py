@@ -12,7 +12,11 @@ from __future__ import annotations
 import argparse
 import logging
 import time
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib
 from pathlib import Path
 
 import numpy as np

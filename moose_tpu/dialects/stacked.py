@@ -313,6 +313,57 @@ def _op_ring_width(op: Operation) -> Optional[int]:
     return None
 
 
+def _logical_rank(v) -> Optional[int]:
+    if isinstance(v, SpmdFixed):
+        return len(v.tensor.shape)
+    if isinstance(v, (SpmdRep, SpmdBits)):
+        return len(v.shape)
+    return None
+
+
+def _insert_logical_axes(v, n: int):
+    """Prepend ``n`` singleton LOGICAL axes — right after the
+    (party, slot) stacking prefix — to one stacked value."""
+    if n <= 0:
+        return v
+    if isinstance(v, SpmdFixed):
+        return SpmdFixed(
+            _insert_logical_axes(v.tensor, n),
+            v.integral_precision, v.fractional_precision,
+        )
+
+    def expand(a):
+        if a is None:
+            return None
+        return jnp.reshape(a, a.shape[:2] + (1,) * n + a.shape[2:])
+
+    if isinstance(v, SpmdRep):
+        return SpmdRep(expand(v.lo), expand(v.hi), v.width)
+    if isinstance(v, SpmdBits):
+        return SpmdBits(expand(v.arr))
+    return v
+
+
+def _align_logical_ranks(*vals):
+    """NumPy broadcasting right-aligns trailing dims, but stacked
+    arrays carry a (party, slot) PREFIX: logical (6, 14) against (14,)
+    stacks to (3, 2, 6, 14) against (3, 2, 14), which misaligns 6
+    against 2 and fails.  Insert singleton logical axes on the
+    lower-rank operands so elementwise kernels broadcast by LOGICAL
+    shape, exactly like the per-host layout (exercised by e.g. the
+    tree-ensemble predictor's thresholds-vector-vs-gathered-features
+    comparison)."""
+    ranks = [_logical_rank(v) for v in vals]
+    known = [r for r in ranks if r is not None]
+    if not known:
+        return vals
+    top = max(known)
+    return tuple(
+        _insert_logical_axes(v, top - r) if r is not None else v
+        for v, r in zip(vals, ranks)
+    )
+
+
 def _execute_rep(sess: StackedSession, comp, op: Operation,
                  rep: ReplicatedPlacement, args):
     kind = op.kind
@@ -343,6 +394,8 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         if isinstance(x, Mir3FixedTensor) and kind in ("Add", "Sub", "Mul"):
             return _public_binop(sess, as_rep(y), x, kind, right=False)
         xr, yr = as_rep(x), as_rep(y)
+        if kind != "Dot":  # contraction has its own shape rules
+            xr, yr = _align_logical_ranks(xr, yr)
         bare_x, bare_y = isinstance(xr, SpmdRep), isinstance(yr, SpmdRep)
         if bare_x != bare_y:
             raise TypeMismatchError(
@@ -413,8 +466,7 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return spmd.neg(x)
 
     if kind in ("Less", "Greater", "Equal"):
-        x = as_rep(args[0])
-        y = as_rep(args[1])
+        x, y = _align_logical_ranks(as_rep(args[0]), as_rep(args[1]))
         xt = x.tensor if isinstance(x, SpmdFixed) else x
         yt = y.tensor if isinstance(y, SpmdFixed) else y
         if kind == "Less":
@@ -432,9 +484,9 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         return fn(sess.spmd, x, y)
 
     if kind == "Mux":
-        s = as_rep(args[0])
-        x = as_rep(args[1])
-        y = as_rep(args[2])
+        s, x, y = _align_logical_ranks(
+            as_rep(args[0]), as_rep(args[1]), as_rep(args[2])
+        )
         if not isinstance(s, SpmdBits):
             raise TypeMismatchError(
                 f"stacked Mux selector must be shared bits, got "
@@ -493,7 +545,7 @@ def _execute_rep(sess: StackedSession, comp, op: Operation,
         )
 
     if kind == "Maximum":
-        vals = [as_rep(a) for a in args]
+        vals = list(_align_logical_ranks(*[as_rep(a) for a in args]))
         if isinstance(vals[0], SpmdRep):
             raise TypeMismatchError(
                 "Maximum on secret uint64 needs a signed comparison "

@@ -281,6 +281,15 @@ class ChaosNetworking:
             )
         return result
 
+    def send_many(self, items, receiver: str, session_id: str):
+        """Decompose a coalesced envelope into per-key sends: every
+        fault decision keys on the STABLE rendezvous key and attempt
+        count, so a seed's schedule is identical whether the worker
+        fast path batched the sends or not (the bit-exact-replay
+        contract with worker jit on)."""
+        for rendezvous_key, value in items:
+            self.send(value, receiver, rendezvous_key, session_id)
+
     def receive(self, *args, **kwargs):
         self._config.check_alive(self._identity)
         return self._inner.receive(*args, **kwargs)

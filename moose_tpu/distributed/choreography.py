@@ -148,6 +148,14 @@ class WorkerServer:
             networking = self.chaos.wrap(networking, identity)
         self.networking = networking
         self._sessions: dict = {}  # session id -> _SessionState (running)
+        # serialized-computation memo: repeat sessions of one computation
+        # (serving traffic) must share ONE deserialized object, because
+        # the worker's resolved role plans are weak-keyed on it — a
+        # fresh object per launch would re-validate and re-jit every
+        # session (same discipline as runtime._bin_cache)
+        self._bin_cache: "collections.OrderedDict" = (
+            collections.OrderedDict()
+        )
         self._aborted: "collections.deque[str]" = collections.deque()
         # aborted session -> root-cause envelope, served through pings:
         # a peer that missed the abort fanout adopts the abort WITH its
@@ -204,7 +212,7 @@ class WorkerServer:
                 # deserialization happens off the rpc thread: a large
                 # lowered graph (an AES decrypt circuit is ~200k ops)
                 # would otherwise hold the launch rpc past its deadline
-                comp = deserialize_computation(msg["computation"])
+                comp = self._computation_for(msg["computation"])
                 state.peers.extend(
                     plc.name for plc in comp.placements.values()
                     if isinstance(plc, HostPlacement)
@@ -234,6 +242,11 @@ class WorkerServer:
                         for name, value in result["outputs"].items()
                     },
                     "elapsed_time_micros": result["elapsed_time_micros"],
+                    # resolved worker-plan shape rides along so the
+                    # client (and the distributed smoke/bench) can
+                    # assert every role reached its compiled plan
+                    "plan_mode": result.get("plan_mode"),
+                    "pinned_segments": result.get("pinned_segments", []),
                 })
             except SessionAbortedError as e:
                 # someone else's root cause cancelled us; the initiator
@@ -284,6 +297,33 @@ class WorkerServer:
 
         threading.Thread(target=run, daemon=True).start()
         return _pack({"ok": True})
+
+    # bound on memoized deserialized computations (a serving deployment
+    # cycles through a handful of models; 32 mirrors runtime._bin_cache)
+    _MAX_BIN_CACHE = 32
+
+    def _computation_for(self, blob: bytes):
+        """Deserialize ``blob``, memoized on the bytes: the worker's
+        resolved role plans (worker_plan) are weak-keyed on the
+        Computation object, so repeat sessions must share it for the
+        plan cache — and its validated jit — to survive across
+        launches."""
+        from ..serde import deserialize_computation
+
+        with self._lock:
+            comp = self._bin_cache.get(blob)
+            if comp is not None:
+                self._bin_cache.move_to_end(blob)
+                return comp
+        comp = deserialize_computation(blob)
+        with self._lock:
+            existing = self._bin_cache.get(blob)
+            if existing is not None:
+                return existing
+            self._bin_cache[blob] = comp
+            while len(self._bin_cache) > self._MAX_BIN_CACHE:
+                self._bin_cache.popitem(last=False)
+        return comp
 
     def _retrieve(self, request: bytes, context=None) -> bytes:
         # results carry the computation's outputs — only the configured
@@ -604,7 +644,12 @@ class WorkerServer:
         # rejected (not silently ACKed) on this path too
         frame = _unpack(request)
         self.networking.verify_sender(frame, context)
-        session_id = frame.get("key", "").split("/", 1)[0]
+        batch = frame.get("batch")
+        if batch:  # coalesced send_many envelope: one session per frame
+            first_key = batch[0].get("key", "")
+        else:
+            first_key = frame.get("key", "")
+        session_id = first_key.split("/", 1)[0]
         with self._lock:
             aborted = session_id in self._aborted
         if aborted:
@@ -681,6 +726,26 @@ class WorkerServer:
 
     def wait(self):
         self._server.wait_for_termination()
+
+
+def start_local_cluster(identities, storages=None, **server_kwargs):
+    """In-process WorkerServer cluster on ephemeral 127.0.0.1 gRPC
+    ports, endpoints cross-wired after every port is known (port 0 means
+    the endpoint map cannot be built up front) — the single bootstrap
+    shared by bench.py, scripts/dist_smoke.py and tests.  Returns
+    ``(servers, endpoints)``; caller stops each server."""
+    servers, endpoints = {}, {}
+    for name in identities:
+        srv = WorkerServer(
+            name, 0, {}, storage=(storages or {}).get(name),
+            **server_kwargs,
+        ).start()
+        servers[name] = srv
+        endpoints[name] = f"127.0.0.1:{srv.port}"
+    for srv in servers.values():
+        srv.endpoints.update(endpoints)
+        srv.networking._endpoints.update(endpoints)
+    return servers, endpoints
 
 
 def _serialize_output(value) -> bytes:

@@ -118,6 +118,17 @@ class GrpcClientRuntime:
         # attempts, per-party errors, injected chaos faults (the
         # distributed mirror of runtime.last_plan)
         self.last_session_report: dict = {}
+        # compiled-computation memo, weak-keyed on the logical
+        # computation: lowering bakes fresh DeriveSeed sync-key nonces,
+        # so re-compiling per session would ship DIFFERENT bytes each
+        # time and the workers' role-plan caches (weak-keyed on the
+        # deserialized computation, memoized by bytes) could never hit —
+        # every session would re-validate and re-jit
+        import weakref
+
+        self._compile_cache: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
 
     # -- one attempt ----------------------------------------------------
 
@@ -237,6 +248,7 @@ class GrpcClientRuntime:
         }
         outputs: dict = {}
         timings: dict = {}
+        plan_modes: dict = {}
         try:
             done, pending = futures_wait(
                 futs, timeout=timeout + 15.0,
@@ -255,6 +267,13 @@ class GrpcClientRuntime:
                 _, result = fut.result()
                 attempt_rec["errors"].setdefault(name, "ok")
                 timings[name] = result.get("elapsed_time_micros", 0)
+                if result.get("plan_mode") is not None:
+                    plan_modes[name] = {
+                        "plan_mode": result["plan_mode"],
+                        "pinned_segments": result.get(
+                            "pinned_segments", []
+                        ),
+                    }
                 for out_name, blob in (
                     result.get("outputs") or {}
                 ).items():
@@ -285,7 +304,7 @@ class GrpcClientRuntime:
                 raise first_error
         finally:
             pool.shutdown(wait=False)
-        return outputs, timings
+        return outputs, timings, plan_modes
 
     # -- the supervisor loop --------------------------------------------
 
@@ -309,12 +328,25 @@ class GrpcClientRuntime:
         arguments = dict(arguments or {})
         specs = arg_specs_from_arguments(arguments)
         specs.update(arg_specs or {})
-        compiled = compile_computation(
-            computation,
-            DEFAULT_PASSES,
-            arg_specs=specs,
-        )
-        comp_bytes = serialize_computation(compiled)
+        specs_key = tuple(sorted(
+            (n, s) if isinstance(s, (str, int, float))
+            else (n, tuple(s[0]), str(s[1]))
+            for n, s in specs.items()
+        ))
+        per_comp = self._compile_cache.get(computation)
+        if per_comp is None:
+            per_comp = self._compile_cache[computation] = {}
+        cached = per_comp.get(specs_key)
+        if cached is None:
+            compiled = compile_computation(
+                computation,
+                DEFAULT_PASSES,
+                arg_specs=specs,
+            )
+            cached = per_comp[specs_key] = (
+                compiled, serialize_computation(compiled)
+            )
+        compiled, comp_bytes = cached
 
         # each worker receives ONLY the arguments whose Input op lives on
         # its placement — shipping the full cleartext dict to every party
@@ -373,8 +405,10 @@ class GrpcClientRuntime:
                                     per_party_args, attempt_rec,
                                 )
                             with telemetry.span("retrieve"):
-                                outputs, timings = self._retrieve_all(
-                                    session_id, timeout, attempt_rec
+                                outputs, timings, plan_modes = (
+                                    self._retrieve_all(
+                                        session_id, timeout, attempt_rec
+                                    )
                                 )
                         except Exception as exc:
                             attempt_rec["elapsed_s"] = (
@@ -416,4 +450,7 @@ class GrpcClientRuntime:
             name: outputs[name] for name in ordered_output_names(outputs)
         }
         report["timings"] = dict(timings)
+        # resolved per-role worker plans (worker_plan): the distributed
+        # mirror of LocalMooseRuntime.last_plan's plan_mode/pinned_ops
+        report["plan_modes"] = dict(plan_modes)
         return outputs, timings

@@ -236,6 +236,14 @@ class LocalNetworking:
         )
         self._store.put(transfer_key(session_id, rendezvous_key), payload)
 
+    def send_many(self, items, receiver: str, session_id: str):
+        """Coalesced delivery of ``[(rendezvous_key, value), ...]`` to
+        one receiver (the worker fast path batches same-destination
+        sends at segment boundaries); in-memory this is just the loop,
+        kept so local tests exercise the same call shape as gRPC."""
+        for rendezvous_key, value in items:
+            self.send(value, receiver, rendezvous_key, session_id)
+
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
                 cancel=None, progress=None):
@@ -471,30 +479,24 @@ class GrpcNetworking:
         """Server-side handler: unpack (key ‖ value) frame and post it
         (``frame`` lets a caller that already unpacked skip the repeat;
         ``verified`` skips the sender check when the caller already ran
-        :meth:`verify_sender`)."""
+        :meth:`verify_sender`).  A ``batch`` frame (send_many envelope)
+        posts every entry — one rpc carrying several rendezvous
+        payloads of one session."""
         import msgpack
 
         if frame is None:
             frame = msgpack.unpackb(request, raw=False)
         if not verified:
             self.verify_sender(frame, context)
-        self.cells.put(frame["key"], frame["value"])
+        batch = frame.get("batch")
+        if batch is not None:
+            for entry in batch:
+                self.cells.put(entry["key"], entry["value"])
+        else:
+            self.cells.put(frame["key"], frame["value"])
         return b""
 
-    def send(self, value, receiver: str, rendezvous_key: str,
-             session_id: str):
-        import msgpack
-
-        from ..serde import serialize_value
-
-        frame = msgpack.packb(
-            {
-                "key": transfer_key(session_id, rendezvous_key),
-                "sender": self._identity,
-                "value": serialize_value(value),
-            },
-            use_bin_type=True,
-        )
+    def _transmit(self, receiver: str, frame: bytes) -> None:
         # retry with backoff (reference networking/grpc.rs:106-112 retries
         # for up to 5 minutes; workers may come up in any order)
         import time
@@ -526,6 +528,47 @@ class GrpcNetworking:
                     ) from e
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
+
+    def send(self, value, receiver: str, rendezvous_key: str,
+             session_id: str):
+        import msgpack
+
+        from ..serde import serialize_value
+
+        frame = msgpack.packb(
+            {
+                "key": transfer_key(session_id, rendezvous_key),
+                "sender": self._identity,
+                "value": serialize_value(value),
+            },
+            use_bin_type=True,
+        )
+        self._transmit(receiver, frame)
+
+    def send_many(self, items, receiver: str, session_id: str):
+        """One SendValue rpc carrying several rendezvous payloads
+        (``[(rendezvous_key, value), ...]``) — the worker fast path
+        coalesces same-destination sends at segment boundaries so a
+        protocol round costs one envelope per peer instead of one rpc
+        per tensor."""
+        import msgpack
+
+        from ..serde import serialize_value
+
+        frame = msgpack.packb(
+            {
+                "sender": self._identity,
+                "batch": [
+                    {
+                        "key": transfer_key(session_id, key),
+                        "value": serialize_value(value),
+                    }
+                    for key, value in items
+                ],
+            },
+            use_bin_type=True,
+        )
+        self._transmit(receiver, frame)
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,

@@ -82,6 +82,97 @@ class _AnyEvent:
         return any(e.is_set() for e in self._events)
 
 
+def _exec_host_op(op, env: dict, identity: str, arguments: dict,
+                  storage, outputs: dict):
+    """Execute one host-boundary op (Input/Load/Save/Output/PrfKeyGen)
+    eagerly — shared by the legacy parallel scheduler and the compiled
+    fast path (worker_plan), so argument lifting, storage discipline and
+    the fixed-keys gate cannot drift between them."""
+    import jax.numpy as jnp
+
+    from ..execution.interpreter import _lift_array, _to_user_value
+
+    kind = op.kind
+    if kind == "PrfKeyGen":
+        fixed = os.environ.get("MOOSE_TPU_FIXED_KEYS")
+        if fixed:
+            # TEST-ONLY determinism: replicated fixed-point results
+            # carry +-1 LSB of share-dependent truncation noise, so
+            # the chaos layer's bit-exactness checks (chaos run vs
+            # clean run, retry vs first attempt) need reproducible
+            # keys.  Gated like the weak default PRF: a real
+            # deployment must never run with derivable keys.
+            if os.environ.get("MOOSE_TPU_ALLOW_WEAK_PRF") != "1":
+                from ..errors import ConfigurationError
+
+                raise ConfigurationError(
+                    "MOOSE_TPU_FIXED_KEYS is a testing knob and "
+                    "requires MOOSE_TPU_ALLOW_WEAK_PRF=1 — fixed "
+                    "PRF keys void all inter-party secrecy"
+                )
+            import hashlib
+
+            digest = hashlib.blake2b(
+                f"{fixed}|{identity}|{op.name}".encode(),
+                digest_size=16,
+            ).digest()
+            words = np.frombuffer(digest, dtype=np.uint32)
+        else:
+            # each party generates its own key from local entropy —
+            # this is where the distributed deployment gets real
+            # inter-party security, unlike the single-trust-domain
+            # local runtime
+            words = np.frombuffer(
+                secrets.token_bytes(16), dtype=np.uint32
+            )
+        return HostPrfKey(jnp.asarray(words), identity)
+    if kind == "Input":
+        val = arguments.get(op.name)
+        if val is None:
+            raise MissingArgumentError(
+                f"missing argument {op.name!r} on {identity}"
+            )
+        if isinstance(val, str):
+            return HostString(val, identity)
+        return _lift_array(np.asarray(val), op, identity)
+    if kind == "Load":
+        key_val = env[op.inputs[0]]
+        key = (
+            key_val.value
+            if isinstance(key_val, HostString)
+            else str(key_val)
+        )
+        query = ""
+        if len(op.inputs) > 1:
+            q = env[op.inputs[1]]
+            query = q.value if isinstance(q, HostString) else str(q)
+        if key not in storage:
+            raise StorageError(
+                f"no value for key {key!r} in storage of {identity!r}"
+            )
+        if hasattr(storage, "load"):
+            raw = storage.load(key, query)
+        else:
+            raw = storage[key]
+        return _lift_array(np.asarray(raw), op, identity)
+    if kind == "Save":
+        key = env[op.inputs[0]]
+        if not isinstance(key, HostString):
+            raise KernelError(
+                f"Save {op.name}: key must be a string, found "
+                f"{type(key).__name__}"
+            )
+        storage[key.value] = _to_user_value(env[op.inputs[1]])
+        return HostUnit(identity)
+    if kind == "Output":
+        value = env[op.inputs[0]]
+        # keyed by the Output tag like the local executors and the
+        # reference (execution/asynchronous.rs:623)
+        outputs[op.attributes.get("tag", op.name)] = _to_user_value(value)
+        return value
+    raise KernelError(f"not a host-boundary op: {kind} ({op.name})")
+
+
 def validate_deployable(comp: Computation) -> None:
     """Reject graphs that would fail opaquely mid-run: composite
     placements (lowering skipped) and raw cross-host edges (networking
@@ -126,7 +217,10 @@ def execute_role(
     progress=None,
 ) -> dict:
     """Execute ``identity``'s share of a lowered computation; returns
-    {"outputs": {...}, "elapsed_time_micros": int}.
+    {"outputs": {...}, "elapsed_time_micros": int, "plan_mode": str,
+    "pinned_segments": [...]} — ``plan_mode`` is the resolved worker
+    plan shape (full-jit / segmented / validating / eager; see
+    :mod:`worker_plan`).
 
     ``cancel``: optional ``threading.Event`` — a set event (choreographer
     abort or peer-failure fanout) stops pending ops and interrupts
@@ -140,10 +234,6 @@ def execute_role(
     deadline would kill any pipeline whose upstream takes longer than
     ``timeout`` to produce.
     """
-    import jax.numpy as jnp
-
-    from ..execution.interpreter import _lift_array, _to_user_value
-
     # genuinely-distributed parties must not derive share masks from the
     # non-cryptographic default PRF (ADVICE r1; the client runtime guards
     # too, but workers execute whatever arrives)
@@ -158,6 +248,19 @@ def execute_role(
     validate_deployable(comp)
     if progress is None:
         progress = ProgressClock()
+
+    # compiled fast path (worker_plan): the role subgraph splits at
+    # Send/Receive boundaries into validated-jit compute segments, sends
+    # go async, receives prefetch — the legacy per-op parallel scheduler
+    # below remains the eager fallback (MOOSE_TPU_WORKER_JIT=0, aes-ctr
+    # PRF, disabled self-check)
+    from . import worker_plan
+
+    if worker_plan.use_fast_path():
+        return worker_plan.execute_role_planned(
+            comp, identity, storage, arguments, networking, session_id,
+            timeout, cancel, progress, worker_plan.get_plan(comp, identity),
+        )
 
     sess = EagerSession(session_id=session_id)
     env: dict = {}
@@ -185,83 +288,10 @@ def execute_role(
                 cancel=abort_any,
                 progress=progress,
             )
-        if kind == "PrfKeyGen":
-            fixed = os.environ.get("MOOSE_TPU_FIXED_KEYS")
-            if fixed:
-                # TEST-ONLY determinism: replicated fixed-point results
-                # carry +-1 LSB of share-dependent truncation noise, so
-                # the chaos layer's bit-exactness checks (chaos run vs
-                # clean run, retry vs first attempt) need reproducible
-                # keys.  Gated like the weak default PRF: a real
-                # deployment must never run with derivable keys.
-                if os.environ.get("MOOSE_TPU_ALLOW_WEAK_PRF") != "1":
-                    from ..errors import ConfigurationError
-
-                    raise ConfigurationError(
-                        "MOOSE_TPU_FIXED_KEYS is a testing knob and "
-                        "requires MOOSE_TPU_ALLOW_WEAK_PRF=1 — fixed "
-                        "PRF keys void all inter-party secrecy"
-                    )
-                import hashlib
-
-                digest = hashlib.blake2b(
-                    f"{fixed}|{identity}|{op.name}".encode(),
-                    digest_size=16,
-                ).digest()
-                words = np.frombuffer(digest, dtype=np.uint32)
-            else:
-                # each party generates its own key from local entropy —
-                # this is where the distributed deployment gets real
-                # inter-party security, unlike the single-trust-domain
-                # local runtime
-                words = np.frombuffer(
-                    secrets.token_bytes(16), dtype=np.uint32
-                )
-            return HostPrfKey(jnp.asarray(words), identity)
-        if kind == "Input":
-            val = arguments.get(op.name)
-            if val is None:
-                raise MissingArgumentError(
-                    f"missing argument {op.name!r} on {identity}"
-                )
-            if isinstance(val, str):
-                return HostString(val, identity)
-            return _lift_array(np.asarray(val), op, identity)
-        if kind == "Load":
-            key_val = env[op.inputs[0]]
-            key = (
-                key_val.value
-                if isinstance(key_val, HostString)
-                else str(key_val)
+        if kind in ("PrfKeyGen", "Input", "Load", "Save", "Output"):
+            return _exec_host_op(
+                op, env, identity, arguments, storage, outputs
             )
-            query = ""
-            if len(op.inputs) > 1:
-                q = env[op.inputs[1]]
-                query = q.value if isinstance(q, HostString) else str(q)
-            if key not in storage:
-                raise StorageError(
-                    f"no value for key {key!r} in storage of {identity!r}"
-                )
-            if hasattr(storage, "load"):
-                raw = storage.load(key, query)
-            else:
-                raw = storage[key]
-            return _lift_array(np.asarray(raw), op, identity)
-        if kind == "Save":
-            key = env[op.inputs[0]]
-            if not isinstance(key, HostString):
-                raise KernelError(
-                    f"Save {op.name}: key must be a string, found "
-                    f"{type(key).__name__}"
-                )
-            storage[key.value] = _to_user_value(env[op.inputs[1]])
-            return HostUnit(identity)
-        if kind == "Output":
-            value = env[op.inputs[0]]
-            # keyed by the Output tag like the local executors and the
-            # reference (execution/asynchronous.rs:623)
-            outputs[op.attributes.get("tag", op.name)] = _to_user_value(value)
-            return value
         args = [env[i] for i in op.inputs]
         return execute_kernel(sess, op, identity, args)
 
@@ -275,7 +305,10 @@ def execute_role(
     abort_any = _AnyEvent(cancel, local_abort)
 
     if not mine:
-        return {"outputs": {}, "elapsed_time_micros": 0}
+        return {
+            "outputs": {}, "elapsed_time_micros": 0,
+            "plan_mode": "eager", "pinned_segments": [],
+        }
 
     pending: dict = {}
     dependents: dict = {name: [] for name in (op.name for op in mine)}
@@ -454,4 +487,7 @@ def execute_role(
         raise SessionAbortedError(f"session {session_id} aborted")
 
     elapsed = int((time.perf_counter() - t0) * 1e6)
-    return {"outputs": outputs, "elapsed_time_micros": elapsed}
+    return {
+        "outputs": outputs, "elapsed_time_micros": elapsed,
+        "plan_mode": "eager", "pinned_segments": [],
+    }

@@ -1,0 +1,824 @@
+"""Compiled fast path for the distributed worker: per-role validated jit
+with communication overlap.
+
+The reference Moose runtime schedules one async task per op on every
+worker (``execution/asynchronous.rs:558-632``); our legacy scheduler in
+:mod:`worker` is the Python-thread re-design of that — and, like the
+reference, pays per-op eager dispatch for every operation.  On the TPU
+backend that dispatch tunnel costs ~4 ms/op, which made the distributed
+deployment (the paper's actual trust model) the last permanently-eager
+path in the framework.
+
+This module gives ``execute_role`` a compiled plan instead:
+
+- the worker's **role subgraph** (its own ops, in global topological
+  order) is split at Send/Receive/host boundaries into **compute
+  segments**; each segment jit-compiles as its own XLA program with the
+  values crossing segment boundaries (including pending Receives)
+  travelling as ordinary jit inputs/outputs — the partial-graph use of
+  ``interpreter.plan_segments``;
+- every segment is **validated** before it is trusted: a worker's own
+  ops are deterministic given their runtime inputs (PrfKeyGen / Sample
+  entropy enters at the host boundary), so each segment's jit candidate
+  runs against its exact eager twin on the same inputs for the plan's
+  first ``MOOSE_TPU_JIT_SELFCHECK`` sessions and must agree
+  bit-for-bit; only the segments that actually diverge are **pinned
+  eager**, exactly like the in-process executors' per-op rung (no
+  single process can compare the *global* outputs — but each worker CAN
+  compare its own, which is all the known miscompile class needs);
+- resolved plans are cached **weak-keyed on (computation, role)**
+  (mirroring the PR-2 plan registry), so repeat sessions — serving
+  traffic through comet — never re-validate and never re-jit;
+- **communication overlaps compute**: Sends enqueue on a background
+  sender thread at segment boundaries (consecutive same-destination
+  payloads coalesce into one ``send_many`` envelope where the transport
+  supports it) while the next segment executes, and all Receives are
+  posted up front so the poller prefetches arriving payloads into
+  segment input slots before the orchestrator needs them.
+
+Chaos compatibility: fault schedules key on the same stable rendezvous
+keys — :class:`~.chaos.ChaosNetworking` decomposes ``send_many`` back
+into per-key ``send`` decisions — so a chaos seed replays the identical
+schedule with worker jit on or off, and ``MOOSE_TPU_FIXED_KEYS`` runs
+stay bit-exact (segments are pure functions of their inputs).
+
+``MOOSE_TPU_WORKER_JIT=0`` (or the test suite's ``MOOSE_TPU_JIT=0``
+default) disables the fast path, restoring the legacy parallel eager
+scheduler.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..errors import NetworkingError, SessionAbortedError
+
+# Kinds the orchestrator resolves on the host side, OUTSIDE compute
+# segments: I/O boundaries, communication, and entropy draws (PrfKeyGen /
+# Sample must stay eager — jitting them would bake one draw into the
+# compiled program and replay it forever).
+_HOST_STEP_KINDS = frozenset({
+    "Input", "Load", "Save", "Output", "Send", "Receive", "PrfKeyGen",
+    "Sample",
+})
+
+# Of those, only some actually FORCE a segment split.  A lowered
+# protocol graph interleaves communication with compute every few ops —
+# splitting at every host step would shatter a role into hundreds of
+# tiny XLA programs (measured ~300 for one logreg role), paying compile
+# and dispatch per fragment.  Instead:
+#  - HOISTABLE ops have no dataflow inputs (PrfKeyGen, Input): they
+#    execute BEFORE the merged segment, their values entering as
+#    ordinary segment inputs;
+#  - DEFERRABLE ops only consume values (Send, Save, Output): they
+#    execute right AFTER the merged segment that produces their
+#    operands.  A deferred Send still flushes before the next receive
+#    WAIT, so the deadlock argument is untouched — the orchestrator
+#    never blocks between a send's original position and its deferred
+#    flush;
+#  - HARD boundaries end the segment: Receive (the value arrives
+#    mid-order), Load (its key is computed locally), Sample (consumes a
+#    locally-computed shape, cannot hoist).
+_HOISTABLE_KINDS = frozenset({"PrfKeyGen", "Input"})
+_DEFERRABLE_KINDS = frozenset({"Send", "Save", "Output"})
+
+# bound on sends deferred behind one merged segment: merging trades
+# send latency (peers wait for the whole segment) for dispatch cost, so
+# cap how much latency one segment may hoard
+_MAX_DEFERRED = 16
+
+
+def _min_seg() -> int:
+    """Segments below this many ops always run eagerly (not validated,
+    not counted as pinned): a 2-op XLA program saves ~one dispatch but
+    costs a compile during validation, and measured role plans carry
+    dozens of such slivers (~35% of a logreg role's segments holding
+    ~5% of its ops)."""
+    raw = os.environ.get("MOOSE_TPU_WORKER_MIN_SEG", "4")
+    try:
+        return max(1, int(raw))
+    except ValueError as e:
+        from ..errors import ConfigurationError
+
+        raise ConfigurationError(
+            f"MOOSE_TPU_WORKER_MIN_SEG must be an integer, got {raw!r}"
+        ) from e
+
+# dynamic-shape kinds XLA cannot compile; segments containing one run
+# eagerly and are never validated (there is no candidate to validate)
+_DYNAMIC_SHAPE_KINDS = frozenset({"Select"})
+
+
+def worker_jit_enabled() -> bool:
+    """Whether the compiled worker fast path is on.  Explicit
+    ``MOOSE_TPU_WORKER_JIT`` wins; the default follows the runtime-wide
+    jit default (``MOOSE_TPU_JIT``), so the test suite's eager default
+    keeps workers eager while deployments get the fast path."""
+    raw = os.environ.get("MOOSE_TPU_WORKER_JIT")
+    if raw is not None:
+        return raw not in ("0", "")
+    return os.environ.get("MOOSE_TPU_JIT", "1") != "0"
+
+
+def use_fast_path() -> bool:
+    """Fast path unless disabled or the PRF implementation is host-side
+    eager-only (aes-ctr kernels cannot trace under jit).  Purely
+    environmental: the same verdict applies to every computation and
+    role."""
+    if not worker_jit_enabled():
+        return False
+    from ..dialects import ring
+
+    if ring.get_prf_impl() == "aes-ctr":
+        return False
+    from ..execution.interpreter import _selfcheck_runs
+
+    # MOOSE_TPU_JIT_SELFCHECK=0 disables the self-check everywhere; an
+    # unvalidated worker jit would reintroduce exactly the miscompile
+    # exposure the local ladder exists to close, so fall back to eager
+    return _selfcheck_runs() > 0
+
+
+# ---------------------------------------------------------------------------
+# plan statistics (asserted by tests: a warm plan never re-validates)
+# ---------------------------------------------------------------------------
+
+PLAN_STATS = {
+    "plans_built": 0,
+    "cache_hits": 0,
+    "validating_evaluations": 0,
+    "segments_pinned": 0,
+}
+_STATS_LOCK = threading.Lock()
+
+
+def _stat(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        PLAN_STATS[key] += n
+
+
+def plan_stats() -> dict:
+    with _STATS_LOCK:
+        return dict(PLAN_STATS)
+
+
+# ---------------------------------------------------------------------------
+# segments
+# ---------------------------------------------------------------------------
+
+
+class _Segment:
+    """One compute segment of a role plan: a run of consecutive
+    non-boundary ops compiled as its own XLA program, validated
+    bit-exactly against its eager twin before being trusted."""
+
+    def __init__(self, index: int, names: list, in_names: list,
+                 out_names: list, comp_ref, identity: str,
+                 validatable: bool, checks: int):
+        self.index = index
+        self.names = names
+        self.in_names = in_names
+        self.out_names = out_names
+        self._comp_ref = comp_ref
+        self._identity = identity
+        self.validatable = validatable
+        # "validating" -> "jit" (promoted) | "eager" (pinned/unjittable)
+        self.mode = "validating" if validatable else "eager"
+        self.pinned = False
+        self.checks_left = checks
+        self._failed_once = False
+        self._eager = None
+        self._jit = None
+        self._lock = threading.Lock()
+
+    def _make_fn(self, fault_kinds=frozenset()):
+        names = self.names
+        outs = self.out_names
+        comp_ref = self._comp_ref
+        identity = self._identity
+
+        def seg(env_in: dict):
+            from ..execution.interpreter import _fault_perturb
+            from ..execution.physical import execute_kernel
+            from ..execution.session import EagerSession
+
+            comp = comp_ref()
+            if comp is None:  # pragma: no cover - defensive
+                raise RuntimeError("computation was garbage-collected")
+            sess = EagerSession(session_id=f"seg-{identity}")
+            env = dict(env_in)
+            for n in names:
+                op = comp.operations[n]
+                args = [env[i] for i in op.inputs]
+                env[n] = execute_kernel(sess, op, identity, args)
+                if fault_kinds and op.kind in fault_kinds:
+                    env[n] = _fault_perturb(env[n])
+            return {n: env[n] for n in outs}
+
+        return seg
+
+    def _eager_fn(self):
+        if self._eager is None:
+            self._eager = self._make_fn()
+        return self._eager
+
+    def _jit_fn(self):
+        if self._jit is None:
+            import jax
+
+            from ..execution.interpreter import _fault_kinds
+
+            # fault injection applies to the CANDIDATE only (the test
+            # hook forcing divergence/pinning on backends without the
+            # real miscompile — see interpreter._fault_kinds)
+            self._jit = jax.jit(self._make_fn(_fault_kinds()))
+        return self._jit
+
+    def run(self, env_in: dict) -> tuple:
+        """Execute the segment; returns ``(out_env, validated)`` where
+        ``validated`` reports whether this call ran a jit-vs-eager
+        comparison (the plan-level "validating evaluation" counter)."""
+        from ..execution.interpreter import _results_equal
+        from ..logger import get_logger
+
+        mode = self.mode
+        if mode == "jit":
+            return self._jit_fn()(env_in), False
+        if mode == "eager":
+            return self._eager_fn()(env_in), False
+        # validating: the eager result is the reference AND the value
+        # the session continues from — a divergent candidate never
+        # contaminates the protocol
+        ref = self._eager_fn()(env_in)
+        pin = False
+        ok = False
+        try:
+            got = self._jit_fn()(env_in)
+            ok = _results_equal(ref, got)
+            pin = not ok
+        except Exception as e:  # noqa: BLE001 — candidate is optional
+            if not self._failed_once:
+                self._failed_once = True
+                get_logger().warning(
+                    "worker segment %d jit candidate failed to run "
+                    "(%s); will retry once", self.index, e,
+                )
+                return ref, True
+            get_logger().warning(
+                "worker segment %d jit candidate failed twice (%s); "
+                "pinning eager", self.index, e,
+            )
+            pin = True
+        with self._lock:
+            if self.mode != "validating":
+                return ref, True  # raced a concurrent session's verdict
+            if pin:
+                self.mode = "eager"
+                self.pinned = True
+                self._jit = None
+                _stat("segments_pinned")
+                get_logger().warning(
+                    "worker segment %d (%d ops, %s..%s) diverged from "
+                    "its eager reference; pinned eager", self.index,
+                    len(self.names), self.names[0], self.names[-1],
+                )
+            elif ok:
+                self.checks_left -= 1
+                if self.checks_left <= 0:
+                    self.mode = "jit"
+                    self._eager = None
+        return ref, True
+
+
+# ---------------------------------------------------------------------------
+# the role plan
+# ---------------------------------------------------------------------------
+
+
+class RolePlan:
+    """Static execution plan for one (computation, role) pair: the
+    ordered step list (host-boundary ops interleaved with compute
+    segments) plus per-segment validated-jit state.  Cached weak-keyed
+    on the computation, so it must not hold it strongly."""
+
+    def __init__(self, comp, identity: str):
+        from ..execution.interpreter import (
+            _segment_limit,
+            _selfcheck_runs,
+            plan_segments,
+        )
+
+        self.identity = identity
+        self._comp_ref = weakref.ref(comp)
+        order = [
+            n for n in comp.toposort_names()
+            if comp.placement_of(comp.operations[n]).name == identity
+        ]
+        self.order = order
+        checks = _selfcheck_runs()
+        limit = _segment_limit()
+
+        # split at HARD host boundaries only, hoisting input-free host
+        # ops before and deferring value-consuming ones after each
+        # merged segment (see the kind sets above); long compute runs
+        # sub-split at the jit segment limit (XLA compile time is
+        # superlinear in program size — same bound as the local
+        # executors)
+        chunks: list[list] = []
+        steps: list = []
+        chunk: list = []
+        pre: list = []
+        post: list = []
+
+        def close():
+            nonlocal chunk, pre, post
+            for n in pre:
+                steps.append(("op", n))
+            if chunk:
+                chunks.append(chunk)
+                steps.append(("seg", len(chunks) - 1))
+            for n in post:
+                steps.append(("op", n))
+            chunk, pre, post = [], [], []
+
+        for n in order:
+            kind = comp.operations[n].kind
+            if kind in _HOISTABLE_KINDS:
+                pre.append(n)
+            elif kind in _DEFERRABLE_KINDS:
+                if not chunk:
+                    close()  # nothing to defer behind: flush hoisted ops
+                    steps.append(("op", n))
+                else:
+                    post.append(n)
+                    if len(post) >= _MAX_DEFERRED:
+                        close()
+            elif kind in _HOST_STEP_KINDS:  # hard: Receive/Load/Sample
+                close()
+                steps.append(("op", n))
+            else:
+                chunk.append(n)
+                if len(chunk) >= limit:
+                    close()
+        close()
+
+        # boundary-dataflow analysis over the partial role graph: values
+        # produced outside any chunk (Receives, host-boundary steps) are
+        # external env inputs
+        _, in_names, _ = plan_segments(
+            order, {}, lambda n: comp.operations[n].inputs, limit,
+            chunks=chunks,
+        )
+        # a segment's outputs are the values ANY later consumer needs —
+        # later segments (their in_names) or host-boundary steps
+        # (Send/Save/Output/... inputs); plan_segments only sees chunk
+        # consumers, so fold the boundary consumers in here
+        needed = set()
+        for ins in in_names:
+            needed.update(ins)
+        for n in order:
+            op = comp.operations[n]
+            if op.kind in _HOST_STEP_KINDS:
+                needed.update(op.inputs)
+        out_names = [
+            sorted(n for n in names if n in needed) for names in chunks
+        ]
+
+        min_seg = _min_seg()
+        self.segments = [
+            _Segment(
+                si, names, in_names[si], out_names[si], self._comp_ref,
+                identity,
+                validatable=(
+                    len(names) >= min_seg
+                    and not any(
+                        comp.operations[n].kind in _DYNAMIC_SHAPE_KINDS
+                        for n in names
+                    )
+                ),
+                checks=checks,
+            )
+            for si, names in enumerate(chunks)
+        ]
+
+        self.steps = steps
+        self.recv_names = [
+            n for n in order if comp.operations[n].kind == "Receive"
+        ]
+
+    @property
+    def pinned_segments(self) -> list:
+        return [s.index for s in self.segments if s.pinned]
+
+    @property
+    def plan_mode(self) -> str:
+        """Resolved (or currently-validating) plan shape: ``full-jit``
+        (the role's whole compute is one jitted program), ``segmented``
+        (several jitted segments, possibly with pins), ``validating``,
+        or ``eager`` (no jittable compute / everything pinned)."""
+        segs = [s for s in self.segments if s.validatable]
+        if not segs:
+            return "eager"
+        if any(s.mode == "validating" for s in segs):
+            return "validating"
+        jitted = [s for s in segs if s.mode == "jit"]
+        if not jitted:
+            return "eager"
+        if len(self.segments) == 1 and not self.pinned_segments:
+            return "full-jit"
+        return "segmented"
+
+
+# Resolved-plan cache, weak-keyed on the computation (the worker server
+# memoizes deserialization by computation bytes, so repeat sessions of
+# one computation share the object and hit here) — the distributed
+# mirror of the PR-2 interpreter._registry.
+_plan_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_cache_lock = threading.Lock()
+
+
+def get_plan(comp, identity: str) -> RolePlan:
+    with _cache_lock:
+        per_comp = _plan_cache.get(comp)
+        if per_comp is None:
+            per_comp = _plan_cache[comp] = {}
+        plan = per_comp.get(identity)
+    if plan is not None:
+        _stat("cache_hits")
+        return plan
+    plan = RolePlan(comp, identity)
+    with _cache_lock:
+        existing = _plan_cache[comp].get(identity)
+        if existing is not None:
+            return existing
+        _plan_cache[comp][identity] = plan
+    _stat("plans_built")
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# communication overlap: async sender + receive prefetcher
+# ---------------------------------------------------------------------------
+
+
+class _AsyncSender:
+    """Background send queue: the orchestrator enqueues (value,
+    receiver, rendezvous key) at segment boundaries and moves on; this
+    thread serializes and transmits off the critical path, coalescing
+    CONSECUTIVE same-destination payloads into one ``send_many``
+    envelope when the transport provides it (one rpc instead of N).
+    Errors become the session's root cause via ``on_error``."""
+
+    def __init__(self, networking, session_id: str, on_error,
+                 progress=None):
+        self._net = networking
+        self._session_id = session_id
+        self._on_error = on_error
+        self._progress = progress
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._closed = False
+        self._error = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="moose-sender",
+        )
+        self._thread.start()
+
+    def enqueue(self, value, receiver: str, rendezvous_key: str) -> None:
+        with self._cv:
+            if self._error is not None:
+                return  # session already failing; drop silently
+            self._items.append((value, receiver, rendezvous_key))
+            self._pending += 1
+            self._cv.notify()
+
+    def _take_batch(self) -> Optional[list]:
+        with self._cv:
+            while not self._items and not self._closed:
+                self._cv.wait(0.2)
+            if not self._items:
+                return None
+            batch = list(self._items)
+            self._items.clear()
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            i = 0
+            while i < len(batch):
+                _, receiver, _ = batch[i]
+                j = i
+                while j < len(batch) and batch[j][1] == receiver:
+                    j += 1
+                group = batch[i:j]
+                try:
+                    if self._error is None:
+                        self._transmit(receiver, group)
+                except BaseException as e:  # noqa: BLE001 — root cause
+                    with self._cv:
+                        if self._error is None:
+                            self._error = e
+                    self._on_error(e)
+                finally:
+                    with self._cv:
+                        self._pending -= len(group)
+                        self._cv.notify_all()
+                i = j
+
+    def _transmit(self, receiver: str, group: list) -> None:
+        send_many = getattr(self._net, "send_many", None)
+        if len(group) > 1 and send_many is not None:
+            send_many(
+                [(key, value) for value, _, key in group], receiver,
+                self._session_id,
+            )
+        else:
+            for value, _, key in group:
+                self._net.send(value, receiver, key, self._session_id)
+        if self._progress is not None:
+            self._progress.bump()
+
+    def flush(self, timeout: float, cancel=None) -> None:
+        """Block until every enqueued send has been transmitted (the
+        worker must not report success while peers still await its
+        payloads); raises the first transmit error, if any."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending > 0 and self._error is None:
+                if cancel is not None and cancel.is_set():
+                    break
+                if time.monotonic() > deadline:
+                    raise NetworkingError(
+                        f"{self._pending} queued send(s) not flushed "
+                        f"after {timeout}s"
+                    )
+                self._cv.wait(0.2)
+            if self._error is not None:
+                raise self._error
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class _ReceivePrefetcher:
+    """Posts EVERY Receive of the role up front and fills arriving
+    payloads into per-name slots while segments compute, so the
+    orchestrator's ``wait`` usually returns immediately.  Pollable
+    transports (try_receive) get one poller thread for all keys; others
+    get one waiter thread per receive (both mirror the legacy
+    scheduler's discipline — receives never occupy compute slots)."""
+
+    def __init__(self, comp, recv_names, networking, session_id: str,
+                 identity: str, timeout: float, cancel, progress,
+                 on_error):
+        self._net = networking
+        self._session_id = session_id
+        self._identity = identity
+        self._timeout = timeout
+        self._cancel = cancel
+        self._progress = progress
+        self._on_error = on_error
+        self._stop = threading.Event()
+        self._values: dict = {}
+        self._events = {n: threading.Event() for n in recv_names}
+        self._ops = {n: comp.operations[n] for n in recv_names}
+        self._threads: list = []
+        if not recv_names:
+            return
+        if hasattr(networking, "try_receive"):
+            t = threading.Thread(
+                target=self._poll, daemon=True,
+                name=f"moose-{identity}-prefetch",
+            )
+            t.start()
+            self._threads.append(t)
+        else:
+            for n in recv_names:
+                t = threading.Thread(
+                    target=self._wait_one, args=(n,), daemon=True,
+                    name=f"moose-{identity}-recv-{n}",
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _arrived(self, name: str, value) -> None:
+        self._values[name] = value
+        self._events[name].set()
+        self._progress.bump()
+
+    def _poll(self) -> None:
+        get_act = getattr(self._net, "activity_for", None)
+        activity = (
+            get_act(self._session_id) if get_act is not None else None
+        )
+        outstanding = dict(self._ops)
+        while outstanding and not self._stop.is_set():
+            if self._cancel is not None and self._cancel.is_set():
+                return
+            if activity is not None:
+                activity.clear()
+            arrived = []
+            for name, op in outstanding.items():
+                try:
+                    ok, val = self._net.try_receive(
+                        op.attributes["sender"],
+                        op.attributes["rendezvous_key"],
+                        self._session_id,
+                        plc=self._identity,
+                    )
+                except BaseException as e:  # noqa: BLE001 — root cause
+                    self._on_error(e)
+                    return
+                if ok:
+                    arrived.append(name)
+                    self._arrived(name, val)
+            for name in arrived:
+                outstanding.pop(name, None)
+            if activity is not None:
+                activity.wait(0.1)
+            else:
+                time.sleep(0.005)
+
+    def _wait_one(self, name: str) -> None:
+        op = self._ops[name]
+        try:
+            val = self._net.receive(
+                op.attributes["sender"],
+                op.attributes["rendezvous_key"],
+                self._session_id,
+                plc=self._identity,
+                timeout=self._timeout,
+                cancel=self._cancel,
+                progress=self._progress,
+            )
+        except SessionAbortedError:
+            return  # the abort is already the session outcome
+        except BaseException as e:  # noqa: BLE001 — root cause
+            self._on_error(e)
+            return
+        self._arrived(name, val)
+
+    def wait(self, name: str):
+        """Block until ``name``'s payload arrived; progress-clock
+        timeout semantics identical to a direct blocking receive."""
+        from .networking import sliced_wait
+
+        op = self._ops[name]
+        sliced_wait(
+            self._events[name].wait, self._timeout, self._cancel,
+            op.attributes["rendezvous_key"], self._progress,
+        )
+        return self._values.pop(name)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# the orchestrator
+# ---------------------------------------------------------------------------
+
+
+def execute_role_planned(
+    comp,
+    identity: str,
+    storage: dict,
+    arguments: dict,
+    networking,
+    session_id: str,
+    timeout: float,
+    cancel,
+    progress,
+    plan: RolePlan,
+) -> dict:
+    """Run one role through its compiled plan: host steps and segments
+    execute in the global topological order (a linearization every
+    worker shares, so the cluster stays deadlock-free: any blocked
+    receive's matching send precedes it globally and sends never block),
+    with sends async behind and receives prefetched ahead."""
+    from .. import telemetry
+    from ..execution.interpreter import prefetch_to_host
+    from .worker import _AnyEvent, _exec_host_op
+
+    from ..execution.physical import execute_kernel
+    from ..execution.session import EagerSession
+
+    t0 = time.perf_counter()
+    env: dict = {}
+    outputs: dict = {}
+    # entropy-drawing host steps (Sample) execute through the same
+    # kernel dispatch the legacy scheduler uses; lazy master key makes
+    # this cheap even when the role has none
+    host_sess = EagerSession(session_id=session_id)
+    local_abort = threading.Event()
+    abort_any = _AnyEvent(cancel, local_abort)
+    failure: list = []
+    flock = threading.Lock()
+
+    def fail(exc: BaseException) -> None:
+        with flock:
+            if not failure:
+                failure.append(exc)
+        local_abort.set()
+
+    sender = _AsyncSender(networking, session_id, fail, progress)
+    prefetcher = _ReceivePrefetcher(
+        comp, plan.recv_names, networking, session_id, identity,
+        timeout, abort_any, progress, fail,
+    )
+    validated = False
+    with telemetry.span(
+        "execute_role", party=identity, steps=len(plan.steps),
+    ) as root:
+        try:
+            for kind, payload in plan.steps:
+                if abort_any.is_set():
+                    raise SessionAbortedError(
+                        f"session {session_id} aborted"
+                    )
+                if kind == "seg":
+                    seg = plan.segments[payload]
+                    with telemetry.span(
+                        "worker_segment", party=identity,
+                        segment=seg.index, ops=len(seg.names),
+                        mode=seg.mode,
+                    ):
+                        out, did_validate = seg.run(
+                            {n: env[n] for n in seg.in_names}
+                        )
+                    env.update(out)
+                    validated |= did_validate
+                    progress.bump()
+                    continue
+                op = comp.operations[payload]
+                if op.kind == "Send":
+                    sender.enqueue(
+                        env[op.inputs[0]],
+                        op.attributes["receiver"],
+                        op.attributes["rendezvous_key"],
+                    )
+                    from ..values import HostUnit
+
+                    env[payload] = HostUnit(identity)
+                elif op.kind == "Receive":
+                    env[payload] = prefetcher.wait(payload)
+                elif op.kind == "Sample":
+                    # unseeded draw: a hard segment boundary (jitting it
+                    # would bake one draw into the compiled program) but
+                    # NOT an _exec_host_op kind — run the legacy
+                    # scheduler's eager kernel
+                    env[payload] = execute_kernel(
+                        host_sess, op, identity,
+                        [env[i] for i in op.inputs],
+                    )
+                    progress.bump()
+                else:
+                    env[payload] = _exec_host_op(
+                        op, env, identity, arguments, storage, outputs
+                    )
+                    if op.kind == "Output":
+                        # start the device-to-host copy while later
+                        # steps (and peers) still compute
+                        prefetch_to_host(env[payload])
+                    progress.bump()
+            sender.flush(timeout, abort_any)
+        except BaseException as e:  # noqa: BLE001 — first error wins
+            fail(e)
+        finally:
+            prefetcher.stop()
+            sender.close()
+        root.attrs["plan_mode"] = plan.plan_mode
+        root.attrs["pinned_segments"] = len(plan.pinned_segments)
+
+    if validated:
+        _stat("validating_evaluations")
+    if failure:
+        exc = failure[0]
+        if cancel is not None and cancel.is_set() and not isinstance(
+            exc, SessionAbortedError
+        ):
+            raise SessionAbortedError(
+                f"session {session_id} aborted"
+            ) from exc
+        raise exc
+    if cancel is not None and cancel.is_set():
+        raise SessionAbortedError(f"session {session_id} aborted")
+
+    elapsed = int((time.perf_counter() - t0) * 1e6)
+    return {
+        "outputs": outputs,
+        "elapsed_time_micros": elapsed,
+        "plan_mode": plan.plan_mode,
+        "pinned_segments": plan.pinned_segments,
+    }

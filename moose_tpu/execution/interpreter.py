@@ -488,6 +488,8 @@ class _PerOpPlan:
         env.update(env_out)
         outputs.update(out_i)
         saves.update(sv_i)
+        if out_i or sv_i:  # overlap host transfer with later chunks
+            prefetch_to_host(out_i, sv_i)
 
     def all_pinned(self) -> bool:
         return self._validatable <= self.pinned
@@ -1029,15 +1031,26 @@ def _segment_limit() -> int:
     return n if n > 0 else (1 << 62)
 
 
-def plan_segments(order, static_env, effective_inputs, limit):
+def plan_segments(order, static_env, effective_inputs, limit, chunks=None):
     """Shared boundary-dataflow analysis for segmented execution (used by
-    both the logical and physical executors): split ``order`` into
-    consecutive ``limit``-sized chunks and compute, per chunk, which
-    earlier-produced values it consumes (``in_names``) and which of its
-    values later chunks need (``out_names``).  ``effective_inputs(name)``
-    yields the dataflow inputs of one op (the physical executor maps a
-    Receive to its Send's input here)."""
-    chunks = [order[i:i + limit] for i in range(0, len(order), limit)]
+    the logical and physical executors AND the distributed worker's role
+    plan): split ``order`` into consecutive ``limit``-sized chunks and
+    compute, per chunk, which earlier-produced values it consumes
+    (``in_names``) and which of its values later chunks need
+    (``out_names``).  ``effective_inputs(name)`` yields the dataflow
+    inputs of one op (the physical executor maps a Receive to its Send's
+    input here).
+
+    ``chunks`` overrides the fixed-size split with an explicit chunk
+    list — the distributed worker segments its role subgraph at
+    Send/Receive boundaries, so its chunks are irregular.  The analysis
+    then also tolerates PARTIAL graphs: an input whose producer sits in
+    no chunk (a pending Receive, a host-boundary op the orchestrator
+    resolves itself) is treated as an external env value — it crosses
+    into its consuming chunk as an ordinary input and is never scheduled
+    as a chunk output."""
+    if chunks is None:
+        chunks = [order[i:i + limit] for i in range(0, len(order), limit)]
     produced_by = {}
     for si, names in enumerate(chunks):
         for n in names:
@@ -1050,7 +1063,7 @@ def plan_segments(order, static_env, effective_inputs, limit):
             for i in effective_inputs(n):
                 if i in static_env:
                     continue
-                if produced_by[i] != si:
+                if produced_by.get(i, -1) != si:
                     ins.add(i)
         in_names.append(sorted(ins))
     out_names: list[list[str]] = [[] for _ in chunks]
@@ -1058,10 +1071,29 @@ def plan_segments(order, static_env, effective_inputs, limit):
         needed = set()
         for sj in range(si + 1, len(chunks)):
             needed.update(
-                n for n in in_names[sj] if produced_by[n] == si
+                n for n in in_names[sj] if produced_by.get(n) == si
             )
         out_names[si] = sorted(needed)
     return chunks, in_names, out_names
+
+
+def prefetch_to_host(*trees) -> None:
+    """Start device-to-host transfers for every array leaf of ``trees``
+    without blocking.  Called on outputs/saves as soon as a segment (or
+    the whole plan) produces them, so the final numpy conversion finds
+    the bytes already on host instead of paying one serialized
+    device-to-host round trip per output at the end
+    (``result_to_host_latency_s`` was ~3x the compute latency on
+    tunneled setups, BENCH_r05)."""
+    for leaf in jax.tree_util.tree_leaves(trees):
+        fn = getattr(leaf, "copy_to_host_async", None)
+        if fn is None:
+            continue
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — purely advisory: a tracer or
+            # an already-deleted buffer just means nothing to prefetch
+            pass
 
 
 def build_segmented_runner(order, static_env, dynamic_names,
@@ -1126,6 +1158,11 @@ def build_segmented_runner(order, static_env, dynamic_names,
             env.update(env_out)
             outputs.update(out_i)
             saves.update(sv_i)
+            # results this segment finished transfer to host WHILE the
+            # remaining segments compute (the final gather then finds
+            # them resident instead of fetching serially at the end)
+            if out_i or sv_i:
+                prefetch_to_host(out_i, sv_i)
         return outputs, saves
 
     return run
@@ -1399,6 +1436,9 @@ class Interpreter:
             self.last_plan_info = info
             sp.attrs["plan_mode"] = info["plan_mode"]
             sp.attrs["pinned_ops"] = len(info["pinned_ops"])
+            # all transfers start before any blocks: the per-output numpy
+            # conversions below then overlap instead of serializing
+            prefetch_to_host(outputs, saves)
             for (plc_name, key), value in saves.items():
                 storage.setdefault(plc_name, {})[key] = _to_user_value(value)
             return {

@@ -678,8 +678,16 @@ class PhysicalInterpreter:
             sp.attrs["plan_mode"] = info["plan_mode"]
             sp.attrs["pinned_ops"] = len(info["pinned_ops"])
 
-        from .interpreter import _to_user_value, ordered_output_names
+        from .interpreter import (
+            _to_user_value,
+            ordered_output_names,
+            prefetch_to_host,
+        )
 
+        # start every device-to-host transfer before any conversion
+        # blocks (serialized per-output fetches dominated latency on
+        # tunneled setups — BENCH_r05 result_to_host_latency_s)
+        prefetch_to_host(outputs, saves)
         for (plc_name, key), value in saves.items():
             storage.setdefault(plc_name, {})[key] = _to_user_value(value)
         return {

@@ -51,15 +51,31 @@ class EagerSession:
     def __init__(self, session_id: Optional[str] = None, master_key=None,
                  key_domain: int = 0):
         self.session_id = session_id or secrets.token_hex(8)
-        if master_key is None:
-            master_key = np.frombuffer(secrets.token_bytes(16), dtype=np.uint32)
-        self._master = jnp.asarray(master_key, dtype=jnp.uint32)
+        # lazy: physical/worker plans feed every PRF key as a runtime
+        # input and never touch the master key, yet they construct one
+        # session per segment (or per op, on the per-op rung) — drawing
+        # entropy and device-putting it on every construction would tax
+        # exactly those hot paths
+        self._master_arr = (
+            None
+            if master_key is None
+            else jnp.asarray(master_key, dtype=jnp.uint32)
+        )
         self._key_counter = 0
         # distinct domains partition the key-derivation nonce space, so
         # several sessions sharing one master key (the segmented-jit
         # executor runs one session per graph segment) never collide
         self._key_domain = int(key_domain)
         self._setup_cache: dict[str, object] = {}
+
+    @property
+    def _master(self):
+        if self._master_arr is None:
+            self._master_arr = jnp.asarray(
+                np.frombuffer(secrets.token_bytes(16), dtype=np.uint32),
+                dtype=jnp.uint32,
+            )
+        return self._master_arr
 
     # -- setup cache (reference execution/synchronous.rs:297-307) ----------
 

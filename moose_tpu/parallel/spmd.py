@@ -404,7 +404,15 @@ def _laxis(arr, axis: int, extra: int = 0) -> int:
 
 
 def _structural(fn):
-    def kernel(x: SpmdRep, *args, **kwargs):
+    def kernel(x, *args, **kwargs):
+        arr = getattr(x, "arr", None)
+        if arr is not None:
+            # SpmdBits (one XOR-shared uint8 array, same (3, 2, *shape)
+            # layout): sharing is linear over Z_2 too, so restructured
+            # bit shares reconstruct to the restructured secret —
+            # exercised by tree-ensemble predictors slicing/indexing
+            # comparison results
+            return type(x)(fn(arr, *args, **kwargs))
         lo = fn(x.lo, *args, **kwargs)
         hi = None if x.hi is None else fn(x.hi, *args, **kwargs)
         return SpmdRep(lo, hi, x.width)

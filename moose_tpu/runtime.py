@@ -442,6 +442,10 @@ class GrpcMooseRuntime:
         # per-party errors, injected chaos faults (mirrors
         # LocalMooseRuntime.last_plan)
         self.last_session_report: Dict = {}
+        # resolved per-role worker plans of the most recent run
+        # ({party: {"plan_mode", "pinned_segments"}}) — the distributed
+        # mirror of LocalMooseRuntime.last_plan
+        self.last_plan_modes: Dict = {}
 
     def set_default(self):
         edsl_base.set_current_runtime(self)
@@ -456,6 +460,9 @@ class GrpcMooseRuntime:
         finally:
             self.last_session_report = dict(
                 self._client.last_session_report
+            )
+            self.last_plan_modes = dict(
+                self.last_session_report.get("plan_modes") or {}
             )
         self.last_timings = dict(timings)
         return outputs, timings

@@ -430,6 +430,88 @@ def test_killed_worker_trips_detector_within_budget():
         _stop_cluster(servers)
 
 
+def test_chaos_drop_bit_exact_with_worker_jit(monkeypatch):
+    """Worker jit must not perturb the chaos contract: with the
+    compiled fast path on (MOOSE_TPU_WORKER_JIT=1), a drop seed still
+    retries to the SAME bits as the chaos-free run — coalesced
+    send_many envelopes decompose back into per-rendezvous-key fault
+    decisions, and segments are pure functions of their inputs under
+    pinned keys."""
+    monkeypatch.setenv("MOOSE_TPU_FIXED_KEYS", "chaos-worker-jit")
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    baseline, base_report = _run_cluster_once(chaos=None)
+    assert base_report["ok"]
+    # every role ran its compiled plan, nothing pinned on a clean graph
+    assert base_report["plan_modes"], base_report
+    for party, mode in base_report["plan_modes"].items():
+        assert mode["plan_mode"] in ("segmented", "full-jit"), (
+            party, mode,
+        )
+        assert mode["pinned_segments"] == [], (party, mode)
+
+    chaos = ChaosConfig(seed=DROP_SEED, drop_send=0.2)
+    out, report = _run_cluster_once(chaos=chaos)
+    assert report["ok"] is True
+    assert report["retried"] is True
+    drops = [f for f in chaos.faults if f["kind"] == "drop_send"]
+    assert drops, "the drop seed must inject at least one drop"
+    assert set(baseline) == set(out)
+    for name in baseline:
+        np.testing.assert_array_equal(
+            np.asarray(baseline[name]), np.asarray(out[name])
+        )
+
+
+def test_chaos_kill_seed_detected_with_worker_jit(monkeypatch):
+    """kill_after_ops under the compiled fast path: the dead party's
+    silence must still trip the survivors' detectors with a typed,
+    retryable error (op budgets count per rendezvous key, so the
+    coalesced sender does not shift the kill point)."""
+    import msgpack
+
+    from moose_tpu.serde import serialize_computation, serialize_value
+
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    args = _args()
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    blob = serialize_computation(compiled)
+    chaos = ChaosConfig(seed=1, kill_after_ops=1, party="carole")
+    servers, _ = _start_cluster(
+        ["alice", "bob", "carole"],
+        ping_interval=0.25, ping_misses=2, startup_grace=5.0,
+        receive_timeout=120.0, chaos=chaos,
+    )
+    try:
+        wire_args = {
+            k: serialize_value(np.asarray(v)) for k, v in args.items()
+        }
+        for srv in servers.values():
+            srv._launch_inner(msgpack.packb(
+                {"session_id": "chaos-kill-jit", "computation": blob,
+                 "arguments": wire_args},
+                use_bin_type=True,
+            ))
+        results = {
+            name: msgpack.unpackb(
+                srv._results.get("chaos-kill-jit", timeout=30.0),
+                raw=False,
+            )
+            for name, srv in servers.items() if name != "carole"
+        }
+        assert any(f["kind"] == "kill" for f in chaos.faults)
+        for name, result in results.items():
+            assert "error" in result, (name, result)
+            exc = from_wire(result["envelope"])
+            assert exc.retryable, (name, result)
+    finally:
+        _stop_cluster(servers)
+
+
 def test_permanent_error_not_retried_and_surfaces_typed(monkeypatch):
     """A CompilationError on ONE worker must cross the wire typed, kill
     the whole session once, and never be retried — not melt into a

@@ -773,6 +773,200 @@ def test_sigkilled_comet_worker_fails_session_everywhere(tmp_path):
                 p.kill()
 
 
+# ---------------------------------------------------------------------------
+# compiled worker fast path (worker_plan): per-role validated jit
+# ---------------------------------------------------------------------------
+
+
+def _stats_delta(before, after):
+    return {k: after[k] - before[k] for k in after}
+
+
+def test_worker_jit_plan_validates_promotes_and_caches(monkeypatch):
+    """The tentpole contract: the first session validates every compute
+    segment (jit candidate vs eager reference, bit-exact), the plan
+    promotes to segmented/full-jit with ZERO pins on a clean graph, and
+    a repeat session of the same computation performs ZERO validating
+    evaluations — the warm plan cache (weak-keyed on (computation,
+    role)) serves the resolved plan."""
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    from moose_tpu.distributed import worker_plan
+
+    rng = np.random.default_rng(0)
+    args = {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+
+    before = worker_plan.plan_stats()
+    net1 = LocalNetworking()
+    r1 = _run_workers(
+        compiled, ["alice", "bob", "carole"], args, lambda i: net1,
+    )
+    d1 = _stats_delta(before, worker_plan.plan_stats())
+    assert d1["plans_built"] == 3
+    assert d1["validating_evaluations"] == 3
+    for r in r1.values():
+        assert r["plan_mode"] in ("segmented", "full-jit"), r
+        assert r["pinned_segments"] == []
+
+    # repeat session, same computation object: warm plans, no validation
+    mid = worker_plan.plan_stats()
+    net2 = LocalNetworking()
+    r2 = _run_workers(
+        compiled, ["alice", "bob", "carole"], args, lambda i: net2,
+    )
+    d2 = _stats_delta(mid, worker_plan.plan_stats())
+    assert d2["plans_built"] == 0
+    assert d2["cache_hits"] == 3
+    assert d2["validating_evaluations"] == 0, d2
+    outs = {
+        k: v for r in r2.values() for k, v in r["outputs"].items()
+    }
+    (val,) = outs.values()
+    np.testing.assert_allclose(val, args["x"] @ args["w"], atol=1e-5)
+
+
+def test_worker_jit_pins_only_divergent_segments(monkeypatch):
+    """MOOSE_TPU_SELFCHECK_FAULT corrupts jit CANDIDATES of the listed
+    kinds: the segments carrying a Dot must pin eager while every other
+    segment stays jitted, and the session result (always continued from
+    the eager reference) stays correct."""
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    monkeypatch.setenv("MOOSE_TPU_SELFCHECK_FAULT", "Dot")
+    from moose_tpu.distributed import worker_plan
+
+    rng = np.random.default_rng(1)
+    args = {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    before = worker_plan.plan_stats()
+    results = None
+    for sid in ("pin-1", "pin-2"):
+        net = LocalNetworking()
+        results = _run_workers(
+            compiled, ["alice", "bob", "carole"], args, lambda i: net,
+        )
+    delta = _stats_delta(before, worker_plan.plan_stats())
+    assert delta["segments_pinned"] > 0
+    pinned = {i: r["pinned_segments"] for i, r in results.items()}
+    assert any(pinned.values()), pinned
+    # selective: pinning one divergent segment must not demote the plan
+    for r in results.values():
+        assert r["plan_mode"] in ("segmented", "full-jit"), r
+    outs = {
+        k: v for r in results.values() for k, v in r["outputs"].items()
+    }
+    (val,) = outs.values()
+    np.testing.assert_allclose(val, args["x"] @ args["w"], atol=1e-5)
+
+
+def test_worker_jit_handles_unseeded_sample(monkeypatch):
+    """Sample is a hard plan boundary (an entropy draw must stay eager,
+    never baked into a compiled segment) but NOT one of the
+    Input/Load/Save/Output/PrfKeyGen host kinds — the orchestrator must
+    route it through the legacy eager kernel dispatch instead of
+    crashing the session (regression: KernelError 'not a host-boundary
+    op')."""
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    from moose_tpu.computation import Operation, Signature, Ty
+
+    rng = np.random.default_rng(3)
+    args = {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    # graft an unseeded draw onto alice's role (the reference SampleOp
+    # shape: Constant HostShape -> Sample ring tensor); the standard
+    # predictor pipeline emits SampleSeeded, so wire graphs carrying
+    # plain Sample come from hand-written / interop computations
+    compiled.add_operation(Operation(
+        "smp_shape", "Constant", [], "alice",
+        Signature((), Ty("HostShape")),
+        attributes={"value": np.asarray([2, 3])},
+    ))
+    compiled.add_operation(Operation(
+        "smp_draw", "Sample", ["smp_shape"], "alice",
+        Signature((Ty("HostShape"),), Ty("HostRing64Tensor")),
+    ))
+    net = LocalNetworking()
+    results = _run_workers(
+        compiled, ["alice", "bob", "carole"], args, lambda i: net,
+    )
+    outs = {
+        k: v for r in results.values() for k, v in r["outputs"].items()
+    }
+    (val,) = outs.values()
+    np.testing.assert_allclose(val, args["x"] @ args["w"], atol=1e-5)
+
+
+def test_worker_jit_off_keeps_legacy_eager_scheduler(monkeypatch):
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "0")
+    from moose_tpu.distributed import worker_plan
+
+    rng = np.random.default_rng(2)
+    args = {"x": rng.normal(size=(3, 3)), "w": rng.normal(size=(3, 1))}
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    before = worker_plan.plan_stats()
+    net = LocalNetworking()
+    results = _run_workers(
+        compiled, ["alice", "bob", "carole"], args, lambda i: net,
+    )
+    assert _stats_delta(before, worker_plan.plan_stats()) == {
+        k: 0 for k in before
+    }
+    for r in results.values():
+        assert r["plan_mode"] == "eager"
+
+
+def test_send_many_envelope_posts_every_payload():
+    """The coalesced send_many frame (worker fast path batching
+    same-destination sends at a segment boundary) delivers every
+    rendezvous payload through one SendValue rpc."""
+    import msgpack
+
+    from moose_tpu.distributed.networking import (
+        GrpcNetworking,
+        transfer_key,
+    )
+    from moose_tpu.serde import serialize_value
+    from moose_tpu.values import host_tensor_from_numpy
+
+    net = GrpcNetworking("bob", {})
+    a = host_tensor_from_numpy(np.arange(4.0), "alice")
+    b = host_tensor_from_numpy(np.arange(6.0) * 2, "alice")
+    frame = msgpack.packb(
+        {
+            "sender": "alice",
+            "batch": [
+                {"key": transfer_key("s-1", "k-a"),
+                 "value": serialize_value(a)},
+                {"key": transfer_key("s-1", "k-b"),
+                 "value": serialize_value(b)},
+            ],
+        },
+        use_bin_type=True,
+    )
+    net.handle_send_value(frame)
+    ok_a, got_a = net.try_receive("alice", "k-a", "s-1", plc="bob")
+    ok_b, got_b = net.try_receive("alice", "k-b", "s-1", plc="bob")
+    assert ok_a and ok_b
+    np.testing.assert_array_equal(np.asarray(got_a.value), np.arange(4.0))
+    np.testing.assert_array_equal(
+        np.asarray(got_b.value), np.arange(6.0) * 2
+    )
+
+
 @pytest.mark.slow
 def test_aes_decrypt_across_grpc_workers():
     """Encrypted-input inference deployed to real workers: the AES
