@@ -178,7 +178,8 @@ def bench_distributed_logreg(batch=128, features=100, iters=4,
     path (MOOSE_TPU_WORKER_JIT=1: per-role validated jit + async
     coalesced sends + receive prefetch) against the legacy eager
     scheduler on the same machine and verifies outputs against sklearn.
-    Returns (jit req/s, eager req/s, {party: plan_mode}); the caller
+    Returns (jit req/s, eager req/s, {party: plan_mode}, comms dict —
+    per-session wire bytes / coalescing / plan-cache rates); the caller
     records ``distributed_worker_jit_ok`` = every worker settled on a
     segmented/full-jit plan — a flag, NOT a hard assert, because on
     real TPU a demoted plan is the self-check catching the known
@@ -252,26 +253,70 @@ def bench_distributed_logreg(batch=128, features=100, iters=4,
                             "plan_modes", {}
                         ).items()
                     }
+            comms_before = _comms_snapshot()
             times = []
             for _ in range(iters):
                 t0 = time.perf_counter()
                 runtime.run_computation(traced, {"x": x}, timeout=600.0)
                 times.append(time.perf_counter() - t0)
-            return batch / float(np.median(times)), modes
+            comms = _comms_delta(comms_before, _comms_snapshot(), iters)
+            return batch / float(np.median(times)), modes, comms
         finally:
             for srv in servers.values():
                 srv.stop()
 
     try:
-        jit_per_sec, modes = measure(True)
-        eager_per_sec, _ = measure(False)
+        jit_per_sec, modes, comms = measure(True)
+        eager_per_sec, _, _ = measure(False)
     finally:
         ring_dialect.set_prf_impl(prev_prf)
         if prev_jit is None:
             os.environ.pop("MOOSE_TPU_WORKER_JIT", None)
         else:
             os.environ["MOOSE_TPU_WORKER_JIT"] = prev_jit
-    return jit_per_sec, eager_per_sec, modes
+    return jit_per_sec, eager_per_sec, modes, comms
+
+
+def _comms_snapshot() -> dict:
+    """Cumulative wire/plan counters off the unified metrics registry
+    (moose_tpu/metrics.py) — the comms-volume side of the distributed
+    bench: BENCH_r06+ tracks bytes and coalescing, not just latency."""
+    from moose_tpu import metrics
+
+    v = metrics.REGISTRY.value
+    return {
+        "tx_bytes": v("moose_tpu_net_tx_bytes_total", transport="grpc"),
+        "rx_bytes": v("moose_tpu_net_rx_bytes_total", transport="grpc"),
+        "sends": v("moose_tpu_net_sends_total", transport="grpc"),
+        "coalesced_envelopes": v(
+            "moose_tpu_net_send_many_total", transport="grpc"
+        ),
+        "coalesced_payloads": v(
+            "moose_tpu_net_send_many_payloads_total", transport="grpc"
+        ),
+        "plan_cache_hits": v("moose_tpu_worker_plan_cache_hits_total"),
+        "plans_built": v("moose_tpu_worker_plans_built_total"),
+    }
+
+
+def _comms_delta(before: dict, after: dict, sessions: int) -> dict:
+    delta = {k: after[k] - before[k] for k in before}
+    hits, built = delta["plan_cache_hits"], delta["plans_built"]
+    return {
+        "sessions": sessions,
+        "tx_bytes_per_session": delta["tx_bytes"] / sessions,
+        "rx_bytes_per_session": delta["rx_bytes"] / sessions,
+        "single_sends_per_session": delta["sends"] / sessions,
+        "coalesced_envelopes_per_session": (
+            delta["coalesced_envelopes"] / sessions
+        ),
+        "coalesced_payloads_per_session": (
+            delta["coalesced_payloads"] / sessions
+        ),
+        "plan_cache_hit_rate": (
+            hits / (hits + built) if (hits + built) else None
+        ),
+    }
 
 
 def _bench_predictor(comp, args, check, batch, layout=None, iters=5,
@@ -814,13 +859,19 @@ def main():
     # scheduler on the same machine, with per-worker plan modes
     try:
         if _within_budget():
-            dist_jit, dist_eager, dist_modes = bench_distributed_logreg()
+            dist_jit, dist_eager, dist_modes, dist_comms = (
+                bench_distributed_logreg()
+            )
             record["distributed_logreg_per_sec"] = dist_jit
             record["distributed_logreg_eager_per_sec"] = dist_eager
             record["distributed_worker_jit_speedup"] = (
                 dist_jit / dist_eager if dist_eager else None
             )
             record["distributed_plan_modes"] = dist_modes
+            # comms volume of the timed jit loop (bytes on the wire,
+            # send coalescing, plan-cache behaviour) so BENCH_r06+
+            # tracks traffic alongside latency
+            record["distributed_comms"] = dist_comms
             # the acceptance contract as a loud flag: a regression that
             # demotes any worker to eager/validating shows up here, not
             # as a quietly-worse throughput number

@@ -123,6 +123,8 @@ def __getattr__(name):
         "elk_compiler": ("elk_compiler", None),
         "parallel": ("parallel", None),
         "telemetry": ("telemetry", None),
+        "metrics": ("metrics", None),
+        "flight": ("flight", None),
     }
     if name in lazy:
         import importlib

@@ -6,6 +6,7 @@ the telemetry exporter: nothing to install in the serving image).
 
   POST /v1/models/<name>:predict   {"x": [[...], ...]}  ->  {"y": [...]}
   GET  /v1/metrics                 serving telemetry snapshot (JSON)
+  GET  /metrics                    unified registry, Prometheus text
   GET  /healthz                    {"status": "ok", "models": [...]}
 
 Every model file is an ONNX graph imported through ``from_onnx`` (the
@@ -98,9 +99,14 @@ def _make_handler(server):
         protocol_version = "HTTP/1.1"
 
         def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
+            self._reply_raw(
+                code, json.dumps(payload).encode(), "application/json"
+            )
+
+        def _reply_raw(self, code: int, body: bytes,
+                       content_type: str) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -117,6 +123,26 @@ def _make_handler(server):
                 )
             elif self.path == "/v1/metrics":
                 self._reply(200, server.metrics_snapshot())
+            elif self.path == "/metrics":
+                # Prometheus text from the unified registry; queue
+                # depths are point-in-time, so refresh the gauge at
+                # scrape time
+                from moose_tpu import metrics as metrics_mod
+
+                depth_gauge = metrics_mod.gauge(
+                    "moose_tpu_serving_queue_depth",
+                    "pending requests per model queue",
+                    ("model",),
+                )
+                for name in server.registry.names():
+                    depth_gauge.set(
+                        server.queue_depth(name), model=name
+                    )
+                self._reply_raw(
+                    200,
+                    metrics_mod.render_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             else:
                 self._reply(404, {"error": "NotFound", "path": self.path})
 

@@ -64,6 +64,14 @@ def main(argv=None):
         "(comet.rs:30-41)",
     )
     parser.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="HTTP metrics/health port: GET /metrics serves Prometheus "
+        "text from the unified registry, /healthz a JSON health "
+        "document, /v1/metrics the JSON snapshot (default: "
+        "MOOSE_TPU_METRICS_PORT; 0 picks an ephemeral port; unset "
+        "disables)",
+    )
+    parser.add_argument(
         "--receive-timeout", type=float, default=None,
         help="seconds a blocked receive tolerates zero session progress "
         "before failing retryably (default: MOOSE_TPU_RECEIVE_TIMEOUT "
@@ -106,7 +114,13 @@ def main(argv=None):
         args.identity, args.port, parse_endpoints(args.endpoints),
         storage=storage, tls=tls, choreographer=args.choreographer,
         receive_timeout=args.receive_timeout,
+        metrics_port=args.metrics_port,
     ).start()
+    if server.metrics_server is not None:
+        logging.getLogger("comet").info(
+            "metrics/health endpoint on http://%s:%d/metrics",
+            server.metrics_server.host, server.metrics_server.port,
+        )
     if server.chaos is not None:
         logging.getLogger("comet").warning(
             "chaos layer ARMED (MOOSE_TPU_CHAOS): deterministic fault "

@@ -57,6 +57,26 @@ def active_configs() -> list:
     return list(_ACTIVE)
 
 
+def _observe_fault(kind: str, detail: dict,
+                   session: Optional[str] = None) -> None:
+    """Mirror an injected fault onto the metrics registry and the
+    flight recorder, so chaos activity is visible on /metrics and in
+    postmortem session reports — not only in the in-process fault log.
+    ``session`` stamps the flight event only: the fault log feeds the
+    cross-run determinism digest and session ids are random per run."""
+    from .. import flight, metrics
+
+    metrics.counter(
+        "moose_tpu_chaos_injections_total",
+        "deterministic chaos faults injected, by kind",
+        ("kind",),
+    ).inc(kind=kind)
+    flight.record(
+        f"chaos_{kind}", party=detail.get("party"), session=session,
+        **{k: v for k, v in detail.items() if k != "party"},
+    )
+
+
 class ChaosConfig:
     """One deterministic fault schedule, shared by every party of an
     in-process cluster (each party wraps its transport via
@@ -151,9 +171,11 @@ class ChaosConfig:
     def _applies(self, identity: str) -> bool:
         return self.party is None or self.party == identity
 
-    def _record(self, kind: str, **detail) -> None:
+    def _record(self, kind: str, _session: Optional[str] = None,
+                **detail) -> None:
         with self._lock:
             self.faults.append({"kind": kind, **detail})
+        _observe_fault(kind, detail, session=_session)
 
     def schedule_digest(self, kinds=None) -> str:
         """Stable digest of the injected-fault log — two runs of the
@@ -183,7 +205,8 @@ class ChaosConfig:
         peers observe a dead endpoint, not a graceful shutdown)."""
         self._kill_hooks[identity] = hook
 
-    def _count_op(self, identity: str) -> None:
+    def _count_op(self, identity: str,
+                  session: Optional[str] = None) -> None:
         if self.kill_after_ops is None or not self._applies(identity):
             return
         fire = False
@@ -201,6 +224,10 @@ class ChaosConfig:
                 })
                 fire = True
         if fire:
+            _observe_fault(
+                "kill", {"party": identity, "after_ops": n - 1},
+                session=session,
+            )
             hook = self._kill_hooks.get(identity)
             if hook is not None:
                 hook()
@@ -239,7 +266,7 @@ class ChaosNetworking:
     def send(self, value, receiver: str, rendezvous_key: str,
              session_id: str, **kwargs):
         cfg = self._config
-        cfg._count_op(self._identity)
+        cfg._count_op(self._identity, session=session_id)
         if not cfg._applies(self._identity):
             return self._inner.send(
                 value, receiver, rendezvous_key, session_id, **kwargs
@@ -249,8 +276,8 @@ class ChaosNetworking:
             cfg._send_count[rendezvous_key] = count + 1
         if cfg.delay_ms > 0:
             cfg._record(
-                "delay", key=rendezvous_key, ms=cfg.delay_ms,
-                party=self._identity,
+                "delay", _session=session_id, key=rendezvous_key,
+                ms=cfg.delay_ms, party=self._identity,
             )
             time.sleep(cfg.delay_ms / 1000.0)
         # only FIRST attempts drop: a supervisor resubmission reuses
@@ -262,7 +289,8 @@ class ChaosNetworking:
             and cfg._fraction("drop_send", rendezvous_key) < cfg.drop_send
         ):
             cfg._record(
-                "drop_send", key=rendezvous_key, party=self._identity,
+                "drop_send", _session=session_id, key=rendezvous_key,
+                party=self._identity,
             )
             return None  # swallowed: the receiver never hears of it
         result = self._inner.send(
@@ -274,7 +302,8 @@ class ChaosNetworking:
             < cfg.dup_send
         ):
             cfg._record(
-                "dup_send", key=rendezvous_key, party=self._identity,
+                "dup_send", _session=session_id, key=rendezvous_key,
+                party=self._identity,
             )
             self._inner.send(
                 value, receiver, rendezvous_key, session_id, **kwargs
@@ -310,8 +339,8 @@ class ChaosNetworking:
                 cfg._ping_count[receiver] = count + 1
             if cfg._fraction("fail_ping", receiver, count) < cfg.fail_ping:
                 cfg._record(
-                    "fail_ping", peer=receiver, party=self._identity,
-                    count=count,
+                    "fail_ping", _session=kwargs.get("session_id"),
+                    peer=receiver, party=self._identity, count=count,
                 )
                 raise NetworkingError(
                     f"chaos: ping to {receiver!r} failed"

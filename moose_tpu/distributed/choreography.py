@@ -42,6 +42,7 @@ from .networking import GrpcNetworking, _CellStore
 LAUNCH = "/moose.Choreography/LaunchComputation"
 RETRIEVE = "/moose.Choreography/RetrieveResults"
 ABORT = "/moose.Choreography/AbortComputation"
+FLIGHT = "/moose.Choreography/GetFlight"
 SEND_VALUE = "/moose.Networking/SendValue"
 ABORT_SESSION = "/moose.Networking/AbortSession"
 PING = "/moose.Networking/Ping"
@@ -91,7 +92,7 @@ class WorkerServer:
                  startup_grace: float = 30.0,
                  receive_timeout: Optional[float] = None,
                  stall_grace: Optional[float] = None,
-                 chaos=None):
+                 chaos=None, metrics_port: Optional[int] = None):
         self.identity = identity
         self.port = port
         self.endpoints = dict(endpoints)
@@ -165,6 +166,25 @@ class WorkerServer:
         self._results = _CellStore()
         self._lock = threading.Lock()
         self._server = None
+        # HTTP metrics/health exposition (GET /metrics Prometheus text,
+        # /healthz, /v1/metrics JSON) — explicit kwarg wins, else
+        # MOOSE_TPU_METRICS_PORT (0 = ephemeral), else disabled
+        self._metrics_port_from_env = False
+        if metrics_port is None:
+            import os
+
+            raw = os.environ.get("MOOSE_TPU_METRICS_PORT")
+            if raw is not None and raw.strip() != "":
+                try:
+                    metrics_port = int(raw)
+                except ValueError as e:
+                    raise NetworkingError(
+                        "MOOSE_TPU_METRICS_PORT must be an integer, "
+                        f"got {raw!r}"
+                    ) from e
+                self._metrics_port_from_env = True
+        self.metrics_port = metrics_port
+        self.metrics_server = None
 
     # -- rpc handlers ---------------------------------------------------
 
@@ -186,11 +206,16 @@ class WorkerServer:
         return self._launch_inner(request)
 
     def _launch_inner(self, request: bytes) -> bytes:
+        from .. import flight, telemetry
         from ..computation import HostPlacement
         from ..serde import deserialize_computation, deserialize_value
 
         msg = _unpack(request)
         session_id = msg["session_id"]
+        # the client's propagated trace position (Dapper-style): this
+        # worker's execute_role root and every span under it — including
+        # detector trips and abort fanouts — join the client's trace
+        trace_ctx = telemetry.TraceContext.from_dict(msg.get("trace"))
         state = _SessionState([])
         with self._lock:
             if session_id in self._aborted:
@@ -202,6 +227,14 @@ class WorkerServer:
             if session_id in self._sessions or session_id in self._completed:
                 raise SessionAlreadyExistsError(session_id)
             self._sessions[session_id] = state
+        flight.record(
+            "launch", party=self.identity, session=session_id,
+            args=sorted(msg.get("arguments") or {}),
+        )
+
+        def run_in_ctx():
+            with telemetry.use_context(trace_ctx):
+                run()
 
         def run():
             from .worker import execute_role
@@ -220,9 +253,15 @@ class WorkerServer:
                     and plc.name in self.endpoints
                 )
                 if state.peers and self.ping_interval > 0:
+                    def detect():
+                        # the detector thread inherits the session's
+                        # trace context so its detector_trip spans
+                        # stitch into the distributed trace
+                        with telemetry.use_context(trace_ctx):
+                            self._failure_detector(session_id, state)
+
                     threading.Thread(
-                        target=self._failure_detector,
-                        args=(session_id, state),
+                        target=detect,
                         daemon=True,
                         name=f"moose-fd-{session_id[:8]}",
                     ).start()
@@ -248,6 +287,12 @@ class WorkerServer:
                     "plan_mode": result.get("plan_mode"),
                     "pinned_segments": result.get("pinned_segments", []),
                 })
+                flight.record(
+                    "session_completed", party=self.identity,
+                    session=session_id,
+                    elapsed_micros=result["elapsed_time_micros"],
+                    plan_mode=result.get("plan_mode"),
+                )
             except SessionAbortedError as e:
                 # someone else's root cause cancelled us; the initiator
                 # already fanned out and (if it was this server) already
@@ -257,12 +302,21 @@ class WorkerServer:
                     "envelope": state.abort_envelope
                     or to_wire(e, self.identity),
                 })
+                flight.record(
+                    "session_aborted", party=self.identity,
+                    session=session_id,
+                    reason=state.abort_reason or "aborted",
+                )
             except Exception as e:  # surfaced on retrieve + fanned out
                 fanout_envelope = to_wire(e, self.identity)
                 fanout_reason = f"{type(e).__name__}: {e}"
                 payload = _pack({
                     "error": fanout_reason, "envelope": fanout_envelope,
                 })
+                flight.record(
+                    "session_error", party=self.identity,
+                    session=session_id, error=fanout_reason,
+                )
             # an aborted session already has its canonical error result;
             # putting again would either clobber it or recreate a
             # never-consumed cell.  The check and put happen under the
@@ -295,7 +349,7 @@ class WorkerServer:
                     envelope=fanout_envelope,
                 )
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run_in_ctx, daemon=True).start()
         return _pack({"ok": True})
 
     # bound on memoized deserialized computations (a serving deployment
@@ -333,6 +387,23 @@ class WorkerServer:
         timeout = float(msg.get("timeout", 120.0))
         return self._results.get(msg["session_id"], timeout)
 
+    def _get_flight(self, request: bytes, context=None) -> bytes:
+        """Serve this process's recent flight-recorder events for the
+        requested session ids (the client's postmortem collection on
+        terminal session failure).  Events describe execution structure
+        — keys, plan modes, error strings — never payload values; still
+        choreographer-gated like retrieve, since error strings may leak
+        operational detail."""
+        self._check_choreographer(context)
+        from .. import flight
+
+        msg = _unpack(request)
+        events = flight.get_recorder().events(
+            sessions=msg.get("session_ids") or (),
+            limit=msg.get("limit"),
+        )
+        return _pack({"events": events})
+
     # bound on remembered aborted/completed ids (replay/late-send
     # protection); old entries age out FIFO so a long-lived worker's
     # state stays bounded
@@ -364,6 +435,12 @@ class WorkerServer:
         is the typed root cause (errors.to_wire) when the aborter knows
         it — a peer's fanned-out failure, a detector trip — so every
         party's result cell re-raises the REAL class at the client."""
+        from .. import flight
+
+        flight.record(
+            "abort", party=self.identity, session=session_id,
+            reason=reason,
+        )
         if envelope is None:
             envelope = to_wire(SessionAbortedError(reason), self.identity)
         with self._lock:
@@ -587,7 +664,7 @@ class WorkerServer:
                     )
                     misses[peer] += 2 if hard else 1
                     if misses[peer] >= trip_at:
-                        from .. import telemetry
+                        from .. import flight, metrics, telemetry
 
                         reason = (
                             f"peer {peer!r} unreachable "
@@ -595,6 +672,16 @@ class WorkerServer:
                         )
                         envelope = to_wire(
                             PeerUnreachableError(reason), self.identity
+                        )
+                        metrics.counter(
+                            "moose_tpu_detector_trips_total",
+                            "failure-detector trips (peer declared "
+                            "unreachable)",
+                        ).inc()
+                        flight.record(
+                            "detector_trip", party=self.identity,
+                            session=session_id, peer=peer,
+                            miss_points=misses[peer],
                         )
                         with telemetry.span(
                             "detector_trip", session_id=session_id,
@@ -674,6 +761,7 @@ class WorkerServer:
             "LaunchComputation": unary(self._launch),
             "RetrieveResults": unary(self._retrieve),
             "AbortComputation": unary(self._abort),
+            "GetFlight": unary(self._get_flight),
         }
         net_handlers = {
             "SendValue": unary(self._send_value),
@@ -705,6 +793,36 @@ class WorkerServer:
         if bound == 0:
             raise NetworkingError(f"cannot bind gRPC port {self.port}")
         self.port = bound
+        if self.metrics_port is not None and self.metrics_server is None:
+            from .. import metrics
+
+            try:
+                self.metrics_server = metrics.serve_http(
+                    self.metrics_port,
+                    health_extra={"identity": self.identity},
+                )
+            except OSError as e:
+                if not self._metrics_port_from_env:
+                    raise NetworkingError(
+                        f"cannot bind metrics port {self.metrics_port}: "
+                        f"{e}"
+                    ) from e
+                # env-derived fixed port + several workers in ONE
+                # process (an in-process cluster inheriting the comet
+                # knob): fall back to an ephemeral port instead of
+                # crashing startup — the registry is process-global, so
+                # any bound port serves the same series
+                from ..logger import get_logger
+
+                get_logger().warning(
+                    "metrics port %d (MOOSE_TPU_METRICS_PORT) already "
+                    "bound in this process; %s falling back to an "
+                    "ephemeral port", self.metrics_port, self.identity,
+                )
+                self.metrics_server = metrics.serve_http(
+                    0, health_extra={"identity": self.identity}
+                )
+            self.metrics_port = self.metrics_server.port
         self._server.start()
         return self
 
@@ -712,6 +830,9 @@ class WorkerServer:
         if self._server is not None:
             self._server.stop(grace)
             self._server = None
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
     def _chaos_kill(self):
         """Chaos ``kill_after_ops`` hook: die like a SIGKILL'd process —
@@ -780,7 +901,7 @@ class ChoreographyClient:
             )
 
     def launch(self, session_id: str, comp_bytes: bytes,
-               arguments: dict):
+               arguments: dict, trace: Optional[dict] = None):
         from ..serde import serialize_value
 
         payload = _pack({
@@ -789,6 +910,9 @@ class ChoreographyClient:
             "arguments": {
                 name: serialize_value(v) for name, v in arguments.items()
             },
+            # the client's TraceContext (telemetry.TraceContext.to_dict)
+            # — the worker's spans join this trace (Dapper propagation)
+            "trace": trace,
         })
         fn = self._channel.unary_unary(LAUNCH)
         # generous: the payload may be a multi-MB serialized graph and
@@ -804,3 +928,14 @@ class ChoreographyClient:
     def abort(self, session_id: str):
         fn = self._channel.unary_unary(ABORT)
         return _unpack(fn(_pack({"session_id": session_id}), timeout=10.0))
+
+    def flight(self, session_ids, limit: Optional[int] = None,
+               timeout: float = 5.0) -> list:
+        """Fetch the worker's recent flight-recorder events for the
+        given session ids (postmortem collection; short timeout — the
+        worker may be the dead party)."""
+        fn = self._channel.unary_unary(FLIGHT)
+        payload = _pack({
+            "session_ids": list(session_ids), "limit": limit,
+        })
+        return _unpack(fn(payload, timeout=timeout)).get("events", [])

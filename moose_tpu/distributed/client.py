@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Optional
 
+from .. import flight as flight_mod
+from .. import metrics as metrics_mod
 from .. import telemetry
 from ..computation import Computation
 from ..errors import (
@@ -31,6 +33,33 @@ from ..errors import (
     is_retryable,
 )
 from .choreography import ChoreographyClient
+
+
+_CLIENT_METRICS = None
+
+
+def _client_metrics():
+    """Lazily-created supervisor counters (cached like
+    networking._net_metrics — one registry lookup per family, ever)."""
+    global _CLIENT_METRICS
+    if _CLIENT_METRICS is None:
+        _CLIENT_METRICS = {
+            "sessions": metrics_mod.counter(
+                "moose_tpu_client_sessions_total",
+                "distributed sessions run by this client, by outcome",
+                ("outcome",),
+            ),
+            "retries": metrics_mod.counter(
+                "moose_tpu_client_retries_total",
+                "retryable session failures that were resubmitted",
+            ),
+            "aborts": metrics_mod.counter(
+                "moose_tpu_client_aborts_total",
+                "client-initiated abort fanouts (partial launch / first "
+                "retrieve error cleanup)",
+            ),
+        }
+    return _CLIENT_METRICS
 
 
 def _retryable(exc: BaseException) -> bool:
@@ -137,6 +166,12 @@ class GrpcClientRuntime:
         workers after a partial launch failure and to unblock survivors
         after the first retrieve error, so no session outlives the
         abort-fanout window."""
+        _client_metrics()["aborts"].inc()
+        flight_mod.record(
+            "client_abort", party="client", session=session_id,
+            parties=sorted(parties),
+        )
+
         def one(name):
             try:
                 self._clients[name].abort(session_id)
@@ -153,7 +188,8 @@ class GrpcClientRuntime:
             t.join(timeout=10.0)
 
     def _launch_all(self, session_id: str, comp_bytes: bytes,
-                    per_party_args: dict, attempt_rec: dict) -> None:
+                    per_party_args: dict, attempt_rec: dict,
+                    trace: Optional[dict] = None) -> None:
         """Fan launches out in parallel.  On ANY failure the workers
         that DID launch are aborted before the typed error is raised —
         a partially-launched session must not sit in blocked receives
@@ -165,7 +201,8 @@ class GrpcClientRuntime:
         def one(name):
             try:
                 resp = self._clients[name].launch(
-                    session_id, comp_bytes, per_party_args[name]
+                    session_id, comp_bytes, per_party_args[name],
+                    trace=trace,
                 )
                 if not resp.get("ok"):
                     raise NetworkingError(
@@ -306,6 +343,49 @@ class GrpcClientRuntime:
             pool.shutdown(wait=False)
         return outputs, timings, plan_modes
 
+    def _collect_flight(self, session_ids) -> list:
+        """Gather every party's recent flight-recorder events for the
+        given session ids: the in-process recorder first (for local
+        clusters it already holds all parties' events — including a
+        chaos-killed party whose rpc endpoint is gone), then each
+        worker's GetFlight rpc best-effort.  Deduplicated on
+        (party, seq) — in-process workers serve the same recorder the
+        direct read saw."""
+        events = flight_mod.get_recorder().events(sessions=session_ids)
+        seen = {(e.get("party"), e.get("seq")) for e in events}
+        # parallel fanout (same discipline as _abort_parties): in a
+        # full partition every rpc times out, and serial 5 s waits
+        # would delay the caller's exception by parties x 5 s
+        remote_lists: dict = {}
+
+        def one(name):
+            try:
+                remote_lists[name] = self._clients[name].flight(
+                    session_ids
+                )
+            except Exception:  # noqa: BLE001 — the dead party can't answer
+                pass
+
+        threads = [
+            threading.Thread(target=one, args=(n,), daemon=True)
+            for n in self._clients
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=6.0)
+        # snapshot: a straggler thread past its join window must not
+        # mutate the dict mid-iteration
+        for remote in list(remote_lists.values()):
+            for event in remote:
+                key = (event.get("party"), event.get("seq"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                events.append(event)
+        events.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+        return events
+
     # -- the supervisor loop --------------------------------------------
 
     def run_computation(
@@ -378,6 +458,7 @@ class GrpcClientRuntime:
             "faults_injected": [],
         }
         self.last_session_report = report
+        session_ids: list = []
 
         with telemetry.span(
             "run_computation", parties=len(self._clients),
@@ -386,6 +467,7 @@ class GrpcClientRuntime:
             try:
                 for attempt in range(1, attempts + 1):
                     session_id = secrets.token_hex(16)
+                    session_ids.append(session_id)
                     attempt_rec = {
                         "session_id": session_id,
                         "status": "ok",
@@ -397,12 +479,24 @@ class GrpcClientRuntime:
                     t0 = time.monotonic()
                     with telemetry.span(
                         "attempt", attempt=attempt, session_id=session_id,
-                    ):
+                    ) as att:
+                        # one TraceContext per session attempt: workers
+                        # adopt it for their execute_role roots, so the
+                        # whole 3-party session exports as ONE stitched
+                        # trace under this attempt span
+                        trace_ctx = telemetry.TraceContext(
+                            att.trace_id, att.span_id
+                        )
+                        flight_mod.record(
+                            "attempt", party="client",
+                            session=session_id, attempt=attempt,
+                        )
                         try:
                             with telemetry.span("launch"):
                                 self._launch_all(
                                     session_id, comp_bytes,
                                     per_party_args, attempt_rec,
+                                    trace=trace_ctx.to_dict(),
                                 )
                             with telemetry.span("retrieve"):
                                 outputs, timings, plan_modes = (
@@ -418,11 +512,19 @@ class GrpcClientRuntime:
                                 f"{type(exc).__name__}: {exc}"
                             )
                             attempt_rec["retryable"] = _retryable(exc)
+                            flight_mod.record(
+                                "attempt_failed", party="client",
+                                session=session_id,
+                                status=attempt_rec["status"],
+                                error=attempt_rec["error"],
+                                retryable=attempt_rec["retryable"],
+                            )
                             if (
                                 not attempt_rec["retryable"]
                                 or attempt >= attempts
                             ):
                                 raise
+                            _client_metrics()["retries"].inc()
                             # capped exponential backoff + jitter before
                             # the resubmission (fresh session id; replay
                             # protection drops stragglers of this one)
@@ -439,7 +541,27 @@ class GrpcClientRuntime:
                     attempt_rec["elapsed_s"] = time.monotonic() - t0
                     report["ok"] = True
                     root.attrs["attempts_used"] = attempt
+                    _client_metrics()["sessions"].inc(outcome="ok")
+                    flight_mod.record(
+                        "session_ok", party="client", session=session_id,
+                        attempts=attempt,
+                    )
                     break
+            except Exception:
+                # terminal failure: attach every party's recent flight
+                # events for the attempted session ids to the report —
+                # the postmortem record that makes a chaos failure
+                # diagnosable, not merely reproducible.  Exception, not
+                # BaseException: a KeyboardInterrupt must propagate
+                # immediately, not sit behind a best-effort rpc fanout.
+                _client_metrics()["sessions"].inc(outcome="failed")
+                flight_mod.record(
+                    "session_failed", party="client",
+                    session=session_ids[-1] if session_ids else None,
+                    attempts=report["n_attempts"],
+                )
+                report["flight"] = self._collect_flight(session_ids)
+                raise
             finally:
                 report["faults_injected"] = _chaos_new_faults(marks)
                 report["retried"] = report["n_attempts"] > 1

@@ -27,6 +27,50 @@ from ..errors import NetworkingError
 
 DEFAULT_TIMEOUT_S = 120.0
 
+_NET_METRICS = None
+
+
+def _net_metrics():
+    """Lazily-created wire counters on the global registry (every
+    transport shares the families, labelled by transport kind)."""
+    global _NET_METRICS
+    if _NET_METRICS is None:
+        from .. import metrics
+
+        _NET_METRICS = {
+            "tx_bytes": metrics.counter(
+                "moose_tpu_net_tx_bytes_total",
+                "serialized bytes handed to the wire",
+                ("transport",),
+            ),
+            "rx_bytes": metrics.counter(
+                "moose_tpu_net_rx_bytes_total",
+                "serialized bytes received off the wire",
+                ("transport",),
+            ),
+            "sends": metrics.counter(
+                "moose_tpu_net_sends_total",
+                "single-payload value sends",
+                ("transport",),
+            ),
+            "send_many": metrics.counter(
+                "moose_tpu_net_send_many_total",
+                "coalesced send_many envelopes",
+                ("transport",),
+            ),
+            "send_many_payloads": metrics.counter(
+                "moose_tpu_net_send_many_payloads_total",
+                "rendezvous payloads carried inside send_many envelopes",
+                ("transport",),
+            ),
+            "receives": metrics.counter(
+                "moose_tpu_net_receives_total",
+                "rendezvous payloads consumed by receives",
+                ("transport",),
+            ),
+        }
+    return _NET_METRICS
+
 # tensors routinely exceed gRPC's 4 MB default cap (an 800x800 float64 is
 # already ~5 MB on the wire); the reference raises the tonic limits the
 # same way for its SendValue payloads
@@ -234,6 +278,10 @@ class LocalNetworking:
         payload = (
             serialize_value(value) if self._serialize else value
         )
+        m = _net_metrics()
+        m["sends"].inc(transport="local")
+        if self._serialize:
+            m["tx_bytes"].inc(len(payload), transport="local")
         self._store.put(transfer_key(session_id, rendezvous_key), payload)
 
     def send_many(self, items, receiver: str, session_id: str):
@@ -241,6 +289,9 @@ class LocalNetworking:
         one receiver (the worker fast path batches same-destination
         sends at segment boundaries); in-memory this is just the loop,
         kept so local tests exercise the same call shape as gRPC."""
+        m = _net_metrics()
+        m["send_many"].inc(transport="local")
+        m["send_many_payloads"].inc(len(items), transport="local")
         for rendezvous_key, value in items:
             self.send(value, receiver, rendezvous_key, session_id)
 
@@ -253,7 +304,10 @@ class LocalNetworking:
             transfer_key(session_id, rendezvous_key), timeout, cancel,
             progress,
         )
+        m = _net_metrics()
+        m["receives"].inc(transport="local")
         if self._serialize:
+            m["rx_bytes"].inc(len(payload), transport="local")
             return deserialize_value(payload, plc)
         return payload
 
@@ -271,7 +325,10 @@ class LocalNetworking:
         )
         if not ok:
             return False, None
+        m = _net_metrics()
+        m["receives"].inc(transport="local")
         if self._serialize:
+            m["rx_bytes"].inc(len(payload), transport="local")
             return True, deserialize_value(payload, plc)
         return True, payload
 
@@ -317,6 +374,9 @@ class TcpNetworking:
         host, port = endpoint.rsplit(":", 1)
         key = transfer_key(session_id, rendezvous_key)
         payload = serialize_value(value)
+        m = _net_metrics()
+        m["sends"].inc(transport="tcp")
+        m["tx_bytes"].inc(len(payload), transport="tcp")
         # retry with backoff so workers may come up in any order
         # (networking/constants.rs backoff discipline)
         delay = 0.05
@@ -368,6 +428,9 @@ class TcpNetworking:
                 return False
 
         sliced_wait(wait_slice, timeout, cancel, key, progress)
+        m = _net_metrics()
+        m["receives"].inc(transport="tcp")
+        m["rx_bytes"].inc(len(box[0]), transport="tcp")
         return deserialize_value(box[0], plc)
 
 
@@ -488,6 +551,7 @@ class GrpcNetworking:
             frame = msgpack.unpackb(request, raw=False)
         if not verified:
             self.verify_sender(frame, context)
+        _net_metrics()["rx_bytes"].inc(len(request), transport="grpc")
         batch = frame.get("batch")
         if batch is not None:
             for entry in batch:
@@ -543,6 +607,9 @@ class GrpcNetworking:
             },
             use_bin_type=True,
         )
+        m = _net_metrics()
+        m["sends"].inc(transport="grpc")
+        m["tx_bytes"].inc(len(frame), transport="grpc")
         self._transmit(receiver, frame)
 
     def send_many(self, items, receiver: str, session_id: str):
@@ -568,6 +635,10 @@ class GrpcNetworking:
             },
             use_bin_type=True,
         )
+        m = _net_metrics()
+        m["send_many"].inc(transport="grpc")
+        m["send_many_payloads"].inc(len(items), transport="grpc")
+        m["tx_bytes"].inc(len(frame), transport="grpc")
         self._transmit(receiver, frame)
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
@@ -579,6 +650,7 @@ class GrpcNetworking:
             transfer_key(session_id, rendezvous_key), timeout, cancel,
             progress,
         )
+        _net_metrics()["receives"].inc(transport="grpc")
         return deserialize_value(payload, plc)
 
     def activity_for(self, session_id: str):
@@ -594,4 +666,5 @@ class GrpcNetworking:
         )
         if not ok:
             return False, None
+        _net_metrics()["receives"].inc(transport="grpc")
         return True, deserialize_value(payload, plc)

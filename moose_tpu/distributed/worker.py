@@ -259,7 +259,8 @@ def execute_role(
     if worker_plan.use_fast_path():
         return worker_plan.execute_role_planned(
             comp, identity, storage, arguments, networking, session_id,
-            timeout, cancel, progress, worker_plan.get_plan(comp, identity),
+            timeout, cancel, progress,
+            worker_plan.get_plan(comp, identity, session_id=session_id),
         )
 
     sess = EagerSession(session_id=session_id)
@@ -276,6 +277,13 @@ def execute_role(
                 op.attributes["receiver"],
                 op.attributes["rendezvous_key"],
                 session_id,
+            )
+            from .. import flight
+
+            flight.record(
+                "send", party=identity, session=session_id,
+                receiver=op.attributes["receiver"], payloads=1,
+                coalesced=False,
             )
             return HostUnit(identity)
         if kind == "Receive":
@@ -454,23 +462,32 @@ def execute_role(
     initial = [op for op in mine if pending[op.name] == 0]
     has_receives = any(op.kind == "Receive" for op in mine)
     poller = None
-    try:
-        for op in initial:
-            dispatch(op)
-        if pollable and has_receives:
-            poller = threading.Thread(
-                target=poll_receives, daemon=True,
-                name=f"moose-{identity}-recv-poller",
-            )
-            poller.start()
-        # `done` fires on completion or local failure; an external abort
-        # (choreographer / peer fanout) only sets its event, so poll it —
-        # in-flight receives unwind via their own sliced waits
-        while not done.wait(0.1):
-            if abort_any.is_set():
-                break
-    finally:
-        pool.shutdown(wait=False, cancel_futures=True)
+    # the eager scheduler's root span: adopts the session's propagated
+    # TraceContext (installed by the worker server around this call) so
+    # even the legacy path stitches into the client's distributed trace
+    from .. import telemetry
+
+    with telemetry.span(
+        "execute_role", party=identity, ops=len(mine), plan_mode="eager",
+    ):
+        try:
+            for op in initial:
+                dispatch(op)
+            if pollable and has_receives:
+                poller = threading.Thread(
+                    target=poll_receives, daemon=True,
+                    name=f"moose-{identity}-recv-poller",
+                )
+                poller.start()
+            # `done` fires on completion or local failure; an external
+            # abort (choreographer / peer fanout) only sets its event, so
+            # poll it — in-flight receives unwind via their own sliced
+            # waits
+            while not done.wait(0.1):
+                if abort_any.is_set():
+                    break
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     if failure:
         exc = failure[0]

@@ -156,10 +156,39 @@ PLAN_STATS = {
 }
 _STATS_LOCK = threading.Lock()
 
+# bridge onto the unified metrics registry (metrics.py): every plan
+# decision is visible on /metrics under these names
+_STAT_METRIC_NAMES = {
+    "plans_built": "moose_tpu_worker_plans_built_total",
+    "cache_hits": "moose_tpu_worker_plan_cache_hits_total",
+    "validating_evaluations": "moose_tpu_worker_plan_validating_total",
+    "segments_pinned": "moose_tpu_worker_segments_pinned_total",
+}
+_STAT_HELP = {
+    "plans_built": "role plans built (compile + boundary analysis)",
+    "cache_hits": "role plans served warm from the (computation, role) "
+                  "cache",
+    "validating_evaluations": "sessions that ran at least one "
+                              "jit-vs-eager segment comparison",
+    "segments_pinned": "segments pinned eager after divergence",
+}
+
+
+_STAT_COUNTERS = None
+
 
 def _stat(key: str, n: int = 1) -> None:
+    global _STAT_COUNTERS
     with _STATS_LOCK:
         PLAN_STATS[key] += n
+    if _STAT_COUNTERS is None:
+        from .. import metrics
+
+        _STAT_COUNTERS = {
+            k: metrics.counter(_STAT_METRIC_NAMES[k], _STAT_HELP[k])
+            for k in _STAT_METRIC_NAMES
+        }
+    _STAT_COUNTERS[key].inc(n)
 
 
 def plan_stats() -> dict:
@@ -239,10 +268,13 @@ class _Segment:
             self._jit = jax.jit(self._make_fn(_fault_kinds()))
         return self._jit
 
-    def run(self, env_in: dict) -> tuple:
+    def run(self, env_in: dict,
+            session_id: Optional[str] = None) -> tuple:
         """Execute the segment; returns ``(out_env, validated)`` where
         ``validated`` reports whether this call ran a jit-vs-eager
-        comparison (the plan-level "validating evaluation" counter)."""
+        comparison (the plan-level "validating evaluation" counter).
+        ``session_id`` stamps a pin's flight event so the decision
+        reaches that session's postmortem."""
         from ..execution.interpreter import _results_equal
         from ..logger import get_logger
 
@@ -282,6 +314,13 @@ class _Segment:
                 self.pinned = True
                 self._jit = None
                 _stat("segments_pinned")
+                from .. import flight
+
+                flight.record(
+                    "segment_pinned", party=self._identity,
+                    session=session_id, segment=self.index,
+                    ops=len(self.names),
+                )
                 get_logger().warning(
                     "worker segment %d (%d ops, %s..%s) diverged from "
                     "its eager reference; pinned eager", self.index,
@@ -442,7 +481,8 @@ _plan_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _cache_lock = threading.Lock()
 
 
-def get_plan(comp, identity: str) -> RolePlan:
+def get_plan(comp, identity: str,
+             session_id: Optional[str] = None) -> RolePlan:
     with _cache_lock:
         per_comp = _plan_cache.get(comp)
         if per_comp is None:
@@ -458,6 +498,15 @@ def get_plan(comp, identity: str) -> RolePlan:
             return existing
         _plan_cache[comp][identity] = plan
     _stat("plans_built")
+    from .. import flight
+
+    # session-stamped so the plan decision reaches the session-filtered
+    # postmortem (last_session_report["flight"])
+    flight.record(
+        "plan_built", party=identity, session=session_id,
+        mode=plan.plan_mode, segments=len(plan.segments),
+        steps=len(plan.steps), receives=len(plan.recv_names),
+    )
     return plan
 
 
@@ -475,20 +524,33 @@ class _AsyncSender:
     Errors become the session's root cause via ``on_error``."""
 
     def __init__(self, networking, session_id: str, on_error,
-                 progress=None):
+                 progress=None, identity: str = ""):
+        from .. import telemetry
+
         self._net = networking
         self._session_id = session_id
         self._on_error = on_error
         self._progress = progress
+        self._identity = identity
+        # the sender thread inherits the enclosing trace context (the
+        # session's launch context) so any span it opens stitches into
+        # the session trace instead of starting an orphan root
+        self._ctx = telemetry.current_context()
         self._items: deque = deque()
         self._cv = threading.Condition()
         self._pending = 0
         self._closed = False
         self._error = None
         self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="moose-sender",
+            target=self._run_thread, daemon=True, name="moose-sender",
         )
         self._thread.start()
+
+    def _run_thread(self) -> None:
+        from .. import telemetry
+
+        with telemetry.use_context(self._ctx):
+            self._loop()
 
     def enqueue(self, value, receiver: str, rendezvous_key: str) -> None:
         with self._cv:
@@ -535,6 +597,8 @@ class _AsyncSender:
                 i = j
 
     def _transmit(self, receiver: str, group: list) -> None:
+        from .. import flight
+
         send_many = getattr(self._net, "send_many", None)
         if len(group) > 1 and send_many is not None:
             send_many(
@@ -544,6 +608,11 @@ class _AsyncSender:
         else:
             for value, _, key in group:
                 self._net.send(value, receiver, key, self._session_id)
+        flight.record(
+            "send", party=self._identity or None,
+            session=self._session_id, receiver=receiver,
+            payloads=len(group), coalesced=len(group) > 1,
+        )
         if self._progress is not None:
             self._progress.bump()
 
@@ -571,6 +640,25 @@ class _AsyncSender:
             self._cv.notify_all()
 
 
+_PREFETCH_COUNTER = None
+
+
+def _prefetch_counter():
+    """Cached family (one registry lookup ever — this sits on the
+    per-receive hot path)."""
+    global _PREFETCH_COUNTER
+    if _PREFETCH_COUNTER is None:
+        from .. import metrics
+
+        _PREFETCH_COUNTER = metrics.counter(
+            "moose_tpu_worker_prefetch_total",
+            "receive waits at the orchestrator, by whether the "
+            "prefetcher already held the payload",
+            ("outcome",),
+        )
+    return _PREFETCH_COUNTER
+
+
 class _ReceivePrefetcher:
     """Posts EVERY Receive of the role up front and fills arriving
     payloads into per-name slots while segments compute, so the
@@ -582,6 +670,8 @@ class _ReceivePrefetcher:
     def __init__(self, comp, recv_names, networking, session_id: str,
                  identity: str, timeout: float, cancel, progress,
                  on_error):
+        from .. import telemetry
+
         self._net = networking
         self._session_id = session_id
         self._identity = identity
@@ -593,12 +683,15 @@ class _ReceivePrefetcher:
         self._values: dict = {}
         self._events = {n: threading.Event() for n in recv_names}
         self._ops = {n: comp.operations[n] for n in recv_names}
+        # prefetch threads inherit the session trace context (no orphan
+        # roots; see _AsyncSender)
+        self._ctx = telemetry.current_context()
         self._threads: list = []
         if not recv_names:
             return
         if hasattr(networking, "try_receive"):
             t = threading.Thread(
-                target=self._poll, daemon=True,
+                target=self._with_ctx, args=(self._poll,), daemon=True,
                 name=f"moose-{identity}-prefetch",
             )
             t.start()
@@ -606,11 +699,18 @@ class _ReceivePrefetcher:
         else:
             for n in recv_names:
                 t = threading.Thread(
-                    target=self._wait_one, args=(n,), daemon=True,
+                    target=self._with_ctx, args=(self._wait_one, n),
+                    daemon=True,
                     name=f"moose-{identity}-recv-{n}",
                 )
                 t.start()
                 self._threads.append(t)
+
+    def _with_ctx(self, fn, *args) -> None:
+        from .. import telemetry
+
+        with telemetry.use_context(self._ctx):
+            fn(*args)
 
     def _arrived(self, name: str, value) -> None:
         self._values[name] = value
@@ -672,12 +772,21 @@ class _ReceivePrefetcher:
     def wait(self, name: str):
         """Block until ``name``'s payload arrived; progress-clock
         timeout semantics identical to a direct blocking receive."""
+        from .. import flight
         from .networking import sliced_wait
 
         op = self._ops[name]
+        hit = self._events[name].is_set()
+        _prefetch_counter().inc(outcome="hit" if hit else "wait")
         sliced_wait(
             self._events[name].wait, self._timeout, self._cancel,
             op.attributes["rendezvous_key"], self._progress,
+        )
+        flight.record(
+            "receive", party=self._identity, session=self._session_id,
+            sender=op.attributes.get("sender"),
+            key=op.attributes.get("rendezvous_key"),
+            prefetched=hit,
         )
         return self._values.pop(name)
 
@@ -732,7 +841,9 @@ def execute_role_planned(
                 failure.append(exc)
         local_abort.set()
 
-    sender = _AsyncSender(networking, session_id, fail, progress)
+    sender = _AsyncSender(
+        networking, session_id, fail, progress, identity=identity
+    )
     prefetcher = _ReceivePrefetcher(
         comp, plan.recv_names, networking, session_id, identity,
         timeout, abort_any, progress, fail,
@@ -755,7 +866,8 @@ def execute_role_planned(
                         mode=seg.mode,
                     ):
                         out, did_validate = seg.run(
-                            {n: env[n] for n in seg.in_names}
+                            {n: env[n] for n in seg.in_names},
+                            session_id=session_id,
                         )
                     env.update(out)
                     validated |= did_validate

@@ -1,0 +1,173 @@
+"""Session flight recorder: a bounded ring of structured events for
+postmortems.
+
+When a distributed session dies, the span tree tells you *where time
+went* and the metrics tell you *how often* things happen — neither
+tells you *what happened, in order*, on each party just before the
+failure.  The flight recorder does: every process keeps a bounded ring
+buffer of structured events (session lifecycle, plan decisions,
+sends / receives, retries, chaos faults, detector trips, aborts), each
+stamped with a wall-clock time, a per-recorder sequence number, the
+party that recorded it and the session it belongs to.
+
+- On terminal session failure the client supervisor attaches every
+  party's recent events for the failed session ids to
+  ``last_session_report["flight"]`` — collected from the in-process
+  recorder (which already holds every party's events for in-process
+  clusters, including a chaos-killed one) and, best effort, over the
+  ``GetFlight`` choreography rpc for out-of-process workers.
+- ``MOOSE_TPU_FLIGHT=/path/events.jsonl`` additionally streams every
+  event as one JSON line for offline debugging (append-only; write
+  errors are swallowed — the recorder must never fail the session it
+  exists to explain).
+- ``MOOSE_TPU_FLIGHT_CAP`` bounds the ring (default 2048 events).
+
+Events are plain dicts so they serialize over msgpack/JSON unchanged::
+
+    {"seq": 17, "ts": 1754..., "kind": "send", "party": "alice",
+     "session": "ab12...", "receiver": "bob", "keys": 3}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Iterable, List, Optional
+
+_DEFAULT_CAP = 2048
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring, optionally streamed as JSONL."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 stream_path: Optional[str] = None):
+        if capacity is None:
+            raw = os.environ.get("MOOSE_TPU_FLIGHT_CAP", "")
+            try:
+                capacity = int(raw) if raw else _DEFAULT_CAP
+            except ValueError:
+                capacity = _DEFAULT_CAP
+        self.capacity = max(16, int(capacity))
+        self._events: "deque[dict]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._stream_path = (
+            stream_path
+            if stream_path is not None
+            else os.environ.get("MOOSE_TPU_FLIGHT") or None
+        )
+        self._stream = None
+        self._stream_failed = False
+
+    # -- producer side -------------------------------------------------
+
+    def record(self, kind: str, party: Optional[str] = None,
+               session: Optional[str] = None, **fields) -> dict:
+        """Append one event; returns it.  Never raises: the recorder
+        exists to explain failures, not to cause them."""
+        with self._lock:
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "kind": str(kind),
+            }
+            if party is not None:
+                event["party"] = party
+            if session is not None:
+                event["session"] = session
+            event.update(fields)
+            self._events.append(event)
+            self._write_stream_locked(event)
+        return event
+
+    def _write_stream_locked(self, event: dict) -> None:
+        if self._stream_path is None or self._stream_failed:
+            return
+        try:
+            if self._stream is None:
+                self._stream = open(  # noqa: SIM115 — long-lived stream
+                    self._stream_path, "a", encoding="utf-8"
+                )
+            self._stream.write(json.dumps(event, default=str) + "\n")
+            self._stream.flush()
+        except OSError:
+            # a bad path / full disk must not take the session down;
+            # one warning's worth of state, then stay silent
+            self._stream_failed = True
+
+    # -- consumer side -------------------------------------------------
+
+    def events(self, session: Optional[str] = None,
+               sessions: Optional[Iterable[str]] = None,
+               party: Optional[str] = None,
+               limit: Optional[int] = None) -> List[dict]:
+        """Recent events, oldest first, optionally filtered by session
+        id(s) and/or party; ``limit`` keeps only the newest N after
+        filtering."""
+        wanted = set(sessions) if sessions is not None else None
+        if session is not None:
+            wanted = (wanted or set()) | {session}
+        with self._lock:
+            out = [
+                dict(e) for e in self._events
+                if (wanted is None or e.get("session") in wanted)
+                and (party is None or e.get("party") == party)
+            ]
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._stream is not None:
+                try:
+                    self._stream.close()
+                except OSError:
+                    pass
+                self._stream = None
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-global recorder (created lazily so the env knobs are
+    read on first use, matching the telemetry exporter discipline)."""
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record(kind: str, party: Optional[str] = None,
+           session: Optional[str] = None, **fields) -> dict:
+    """Record on the process-global recorder."""
+    return get_recorder().record(
+        kind, party=party, session=session, **fields
+    )
+
+
+def configure(capacity: Optional[int] = None,
+              stream_path: Optional[str] = None) -> FlightRecorder:
+    """Replace the global recorder (tests / bins that want an explicit
+    stream path instead of the env knob)."""
+    global _recorder
+    with _recorder_lock:
+        if _recorder is not None:
+            _recorder.close()
+        _recorder = FlightRecorder(
+            capacity=capacity, stream_path=stream_path
+        )
+        return _recorder

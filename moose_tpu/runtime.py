@@ -135,10 +135,10 @@ class LocalMooseRuntime:
         return result
 
     def _surface_plan(self, root) -> None:
-        """Lift the executors' resolved plan shape into ``last_timings``
-        / ``last_plan``: which mode the validated-jit ladder settled on
-        (eager / per-op / segmented / whole-graph) and which ops the
-        per-op rung pinned eager."""
+        """Surface the executors' resolved plan shape as the typed
+        ``last_plan`` dict: which mode the validated-jit ladder settled
+        on (eager / per-op / segmented / whole-graph), which ops the
+        per-op rung pinned eager, and which layout ran."""
         from . import telemetry
 
         info = dict(self._last_plan_info or {})
@@ -148,10 +148,17 @@ class LocalMooseRuntime:
             if mode is None:
                 return
             info["plan_mode"] = mode
-            info.setdefault("pinned_ops", [])
-        self.last_timings["plan_mode"] = info.get("plan_mode")
-        self.last_timings["pinned_ops"] = list(info.get("pinned_ops", ()))
+        # the typed plan surface: these three keys are always present
+        # (plan_mode is guaranteed by the branch above)
+        info["pinned_ops"] = list(info.get("pinned_ops", ()))
+        info.setdefault("layout", None)
         self.last_plan = info
+        # DEPRECATED (remove next release; see DEVELOP.md
+        # "Observability"): plan_mode/pinned_ops are NOT timings, but
+        # rode in last_timings before runtime.last_plan existed — kept
+        # one release for callers that still read them there
+        self.last_timings["plan_mode"] = info["plan_mode"]
+        self.last_timings["pinned_ops"] = list(info["pinned_ops"])
 
     def _evaluate_computation(
         self,

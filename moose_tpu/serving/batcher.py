@@ -68,12 +68,20 @@ class ModelQueue:
     def __post_init__(self):
         self._cv = threading.Condition()
         self._closed = False
+        # the scheduler thread inherits the registration-time trace
+        # context (if any): its serve_batch roots stitch under the
+        # server's trace instead of starting orphan roots per batch
+        self._trace_ctx = telemetry.current_context()
         self._thread = threading.Thread(
-            target=self._loop,
+            target=self._loop_in_ctx,
             daemon=True,
             name=f"serve-{self.model.name}",
         )
         self._thread.start()
+
+    def _loop_in_ctx(self) -> None:
+        with telemetry.use_context(self._trace_ctx):
+            self._loop()
 
     # -- client side -------------------------------------------------------
 

@@ -29,12 +29,53 @@ def _quantile(sorted_values, q: float) -> Optional[float]:
     return sorted_values[max(0, idx)]
 
 
+def _registry_metrics():
+    """Bridge counters on the unified registry (metrics.py): the
+    aggregates below stay the windowed JSON surface, while these are
+    the monotone whole-process series Prometheus scrapes."""
+    from .. import metrics
+
+    return {
+        "batches": metrics.counter(
+            "moose_tpu_serving_batches_total",
+            "micro-batches dispatched",
+        ),
+        "rows": metrics.counter(
+            "moose_tpu_serving_rows_total", "rows served",
+        ),
+        "overloads": metrics.counter(
+            "moose_tpu_serving_overloads_total",
+            "submissions rejected by admission control (HTTP 429)",
+        ),
+        "deadline_misses": metrics.counter(
+            "moose_tpu_serving_deadline_misses_total",
+            "results delivered after their deadline",
+        ),
+        "deadline_drops": metrics.counter(
+            "moose_tpu_serving_deadline_drops_total",
+            "requests expired in queue, never batched (HTTP 504)",
+        ),
+        "eval_failures": metrics.counter(
+            "moose_tpu_serving_eval_failures_total",
+            "batches that failed evaluation",
+        ),
+        "latency": metrics.histogram(
+            "moose_tpu_serving_request_latency_seconds",
+            "request latency from submit to scatter",
+        ),
+    }
+
+
 class ServingMetrics:
     """Thread-safe aggregate serving counters (one instance per
-    :class:`~moose_tpu.serving.server.InferenceServer`)."""
+    :class:`~moose_tpu.serving.server.InferenceServer`).  Every record
+    also increments the unified registry's monotone serving counters,
+    so ``GET /metrics`` (Prometheus) and ``/v1/metrics`` (this
+    windowed JSON snapshot) describe the same traffic."""
 
     def __init__(self, latency_window: int = 4096):
         self._lock = threading.Lock()
+        self._registry = _registry_metrics()
         self.batches = 0
         self.rows_served = 0
         self.fill_sum = 0.0  # sum of rows/bucket over batches
@@ -62,24 +103,32 @@ class ServingMetrics:
                 self.retraces_after_warm += 1
             if validating:
                 self.validating_after_warm += 1
+        self._registry["batches"].inc()
+        self._registry["rows"].inc(rows)
 
     def record_latency(self, seconds: float, missed_deadline: bool) -> None:
         with self._lock:
             self._latencies.append(seconds)
             if missed_deadline:
                 self.deadline_misses += 1
+        self._registry["latency"].observe(seconds)
+        if missed_deadline:
+            self._registry["deadline_misses"].inc()
 
     def record_deadline_drop(self) -> None:
         with self._lock:
             self.deadline_drops += 1
+        self._registry["deadline_drops"].inc()
 
     def record_overload(self) -> None:
         with self._lock:
             self.overloads += 1
+        self._registry["overloads"].inc()
 
     def record_eval_failure(self) -> None:
         with self._lock:
             self.eval_failures += 1
+        self._registry["eval_failures"].inc()
 
     def reset_window(self) -> None:
         """Zero the traffic aggregates (batches, fill, histogram,

@@ -27,6 +27,18 @@ entry point:
   The exporter is stdlib-only (urllib on a daemon thread), never blocks
   the caller, and drops batches rather than stall a worker.
 
+**Distributed trace propagation** (Dapper-style): every span carries a
+stable ``trace_id`` / ``span_id`` minted at creation.  A root span
+adopts the thread's ambient :class:`TraceContext` (installed with
+:func:`use_context`) as its parent, so one logical session exports as
+ONE stitched trace: the client supervisor mints a context per session
+attempt, ships it in the launch rpc, workers adopt it around
+``execute_role``, and background threads (async sender, receive
+prefetcher, failure detector, batch scheduler) inherit the enclosing
+context instead of starting orphan roots.  :func:`current_context`
+captures the innermost active span as a context to hand to a thread or
+a peer.
+
 Runtimes surface coarse phase timings as ``runtime.last_timings``
 (micros, like the reference's per-role map).
 """
@@ -48,6 +60,41 @@ from typing import Any, Dict, List, Optional
 _EPOCH_OFFSET_S = time.time() - time.perf_counter()
 
 
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Propagatable trace position: the trace every new root joins and
+    the span id it hangs under.  Wire shape is a plain two-key dict so
+    it rides msgpack/JSON launch messages unchanged."""
+
+    trace_id: str
+    span_id: str
+
+    @staticmethod
+    def new() -> "TraceContext":
+        return TraceContext(_new_trace_id(), _new_span_id())
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @staticmethod
+    def from_dict(raw) -> Optional["TraceContext"]:
+        if not isinstance(raw, dict):
+            return None
+        trace_id = raw.get("trace_id")
+        span_id = raw.get("span_id")
+        if not trace_id or not span_id:
+            return None
+        return TraceContext(str(trace_id), str(span_id))
+
+
 @dataclass
 class Span:
     name: str
@@ -55,6 +102,12 @@ class Span:
     end_s: float = 0.0
     attrs: Dict[str, Any] = field(default_factory=dict)
     children: List["Span"] = field(default_factory=list)
+    # stable ids minted at creation (OTLP export and cross-party
+    # stitching use these; a root under an ambient TraceContext carries
+    # the REMOTE parent's span id in parent_span_id)
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: Optional[str] = None
 
     @property
     def duration_s(self) -> float:
@@ -87,9 +140,38 @@ class _State(threading.local):
     def __init__(self):
         self.stack: List[Span] = []
         self.last_root: Optional[Span] = None
+        # ambient TraceContext adopted by root spans on this thread
+        # (installed with use_context; inherited by worker/background
+        # threads so their spans stitch into the session trace)
+        self.context: Optional[TraceContext] = None
 
 
 _state = _State()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The innermost active span as a TraceContext (to hand to a
+    thread or ship to a peer), or the thread's ambient context when no
+    span is open, or None."""
+    if _state.stack:
+        s = _state.stack[-1]
+        return TraceContext(s.trace_id, s.span_id)
+    return _state.context
+
+
+@contextmanager
+def use_context(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as this thread's ambient trace context: root
+    spans opened inside become children of ``ctx.span_id`` in
+    ``ctx.trace_id`` instead of minting fresh orphan traces.  ``None``
+    restores orphan-root behaviour (useful to scope a worker thread
+    back out of an adopted session)."""
+    prev = _state.context
+    _state.context = ctx
+    try:
+        yield ctx
+    finally:
+        _state.context = prev
 
 
 def _echo_enabled() -> bool:
@@ -104,9 +186,21 @@ def trace_ops_enabled() -> bool:
 
 @contextmanager
 def span(name: str, **attrs):
-    """Record a timed span; nests under the enclosing span, if any."""
+    """Record a timed span; nests under the enclosing span, if any.
+    Roots adopt the thread's ambient :class:`TraceContext` (see
+    :func:`use_context`) so distributed children stitch into the
+    session trace."""
     s = Span(name=name, start_s=time.perf_counter(), attrs=dict(attrs))
     parent = _state.stack[-1] if _state.stack else None
+    s.span_id = _new_span_id()
+    if parent is not None:
+        s.trace_id = parent.trace_id
+        s.parent_span_id = parent.span_id
+    elif _state.context is not None:
+        s.trace_id = _state.context.trace_id
+        s.parent_span_id = _state.context.span_id
+    else:
+        s.trace_id = _new_trace_id()
     _state.stack.append(s)
     try:
         yield s
@@ -216,23 +310,61 @@ class OtlpExporter:
             self._q.put_nowait(root)
         except queue.Full:
             self.dropped += 1
+            from . import metrics
+
+            metrics.counter(
+                "moose_tpu_otlp_dropped_total",
+                "root span trees dropped (full queue or collector error)",
+            ).inc()
 
     def flush(self, timeout_s: float = 5.0) -> bool:
-        """Wait until everything queued so far has been sent (tests)."""
+        """Wait until everything queued so far has been sent (tests).
+        Returns False (instead of blocking past ``timeout_s``) when the
+        queue stays full or the drain doesn't finish in time — the
+        "never blocks the caller" contract holds here too."""
         # an event sentinel rides the queue behind everything already
         # enqueued; when the worker reaches it, all prior batches have
-        # finished their POSTs
+        # finished their POSTs.  The enqueue itself must not block on a
+        # full queue (a dead drain thread would park the caller forever
+        # on a blocking put), so it retries put_nowait under the SAME
+        # deadline as the wait — the whole call is bounded by timeout_s.
+        deadline = time.monotonic() + timeout_s
         done = threading.Event()
-        self._q.put(done)
-        return done.wait(timeout_s)
+        if not self._put_until(done, deadline):
+            return False
+        return done.wait(max(0.0, deadline - time.monotonic()))
+
+    def _put_with_deadline(self, item, timeout_s: float) -> bool:
+        return self._put_until(item, time.monotonic() + timeout_s)
+
+    def _put_until(self, item, deadline: float) -> bool:
+        while True:
+            try:
+                self._q.put_nowait(item)
+                return True
+            except queue.Full:
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.01)
 
     def shutdown(self) -> None:
-        """Stop the drain thread (after finishing everything queued)."""
-        self._q.put(_SHUTDOWN)
-        self._thread.join(timeout=5.0)
+        """Stop the drain thread (after finishing everything queued).
+        Best effort on a wedged full queue: give up rather than hang."""
+        if self._put_with_deadline(_SHUTDOWN, 5.0):
+            self._thread.join(timeout=5.0)
 
     # -- consumer side --
     def _drain(self) -> None:
+        from . import metrics
+
+        exported_c = metrics.counter(
+            "moose_tpu_otlp_exported_total",
+            "root span trees successfully POSTed to the OTLP collector",
+        )
+        dropped_c = metrics.counter(
+            "moose_tpu_otlp_dropped_total",
+            "root span trees dropped (full queue or collector error)",
+        )
         while True:
             root = self._q.get()
             if root is _SHUTDOWN:
@@ -243,8 +375,10 @@ class OtlpExporter:
             try:
                 self._post(self.encode(root))
                 self.exported += 1
+                exported_c.inc()
             except Exception as e:  # collector down: drop, remember why
                 self.dropped += 1
+                dropped_c.inc()
                 self.last_error = str(e)
 
     def _post(self, payload: dict) -> None:
@@ -256,16 +390,20 @@ class OtlpExporter:
         urllib.request.urlopen(req, timeout=self.timeout_s).read()
 
     def encode(self, root: Span) -> dict:
-        """One root tree -> one OTLP resourceSpans payload."""
-        trace_id = os.urandom(16).hex()
+        """One root tree -> one OTLP resourceSpans payload.  Uses the
+        spans' PROPAGATED ids (minted at span creation, inherited from
+        the ambient TraceContext across threads and parties) so a
+        3-party session exports one stitched trace — not a fresh random
+        trace per exporting process."""
+        trace_id = root.trace_id or _new_trace_id()
         spans: List[dict] = []
 
         def walk(s: Span, parent_id: Optional[str]) -> None:
-            span_id = os.urandom(8).hex()
+            span_id = s.span_id or _new_span_id()
             start_ns = int((s.start_s + _EPOCH_OFFSET_S) * 1e9)
             end_ns = int((s.end_s + _EPOCH_OFFSET_S) * 1e9)
             rec = {
-                "traceId": trace_id,
+                "traceId": s.trace_id or trace_id,
                 "spanId": span_id,
                 "name": s.name,
                 "kind": 1,  # SPAN_KIND_INTERNAL
@@ -279,7 +417,10 @@ class OtlpExporter:
             for child in s.children:
                 walk(child, span_id)
 
-        walk(root, None)
+        # the root's REMOTE parent (the client's attempt span) arrives
+        # through its parent_span_id — minted locally only for true
+        # orphans
+        walk(root, root.parent_span_id)
         return {
             "resourceSpans": [
                 {

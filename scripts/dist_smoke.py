@@ -13,7 +13,14 @@ Asserts:
    by computation bytes) serves the resolved plan warm;
 3. the distributed outputs **match the in-process path**
    (LocalMooseRuntime over the identical traced computation) and
-   sklearn's own predict_proba.
+   sklearn's own predict_proba;
+4. (ISSUE 6 observability) with OTLP configured, one session exports
+   **one stitched trace id** shared by the client spans and every
+   worker's execute_role span; each worker's HTTP metrics port serves
+   **non-empty Prometheus text** carrying worker-plan and networking
+   counters; and a chaos-killed session's report attaches the killed
+   party's **flight-recorder events** (plus retry/chaos counters on
+   /metrics).
 
 Prints one JSON summary line (the CI log artifact).
 
@@ -48,6 +55,182 @@ os.environ.setdefault("MOOSE_TPU_PRF", "threefry")
 CLIENTS_SESSIONS = 3
 FEATURES = 8
 BATCH = 16
+
+
+class _Collector:
+    """Minimal in-process OTLP/HTTP collector capturing POSTed spans."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        collector = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers["Content-Length"])
+                collector.requests.append(
+                    json.loads(self.rfile.read(length))
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        self.requests = []
+        self.server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.endpoint = f"http://127.0.0.1:{self.server.server_port}"
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+
+    def spans(self):
+        out = []
+        for payload in self.requests:
+            for rs in payload["resourceSpans"]:
+                for ss in rs["scopeSpans"]:
+                    out.extend(ss["spans"])
+        return out
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def check_stitched_trace(collector) -> dict:
+    """Exactly one trace id shared by the client's run_computation tree
+    and all three workers' execute_role roots (ISSUE 6 acceptance)."""
+    spans = collector.spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    roots = by_name.get("run_computation", [])
+    assert len(roots) == 1, (
+        f"expected 1 client root span, saw {len(roots)}"
+    )
+    trace_id = roots[0]["traceId"]
+    workers = by_name.get("execute_role", [])
+    parties = set()
+    for s in workers:
+        attrs = {a["key"]: a["value"] for a in s["attributes"]}
+        parties.add(attrs["party"]["stringValue"])
+        assert s["traceId"] == trace_id, (
+            f"worker span in foreign trace: {s['traceId']} != {trace_id}"
+        )
+    assert parties == {"alice", "bob", "carole"}, parties
+    trace_ids = {
+        s["traceId"] for s in spans
+        if s["name"] in (
+            "run_computation", "attempt", "launch", "retrieve",
+            "execute_role", "worker_segment",
+        )
+    }
+    assert trace_ids == {trace_id}, (
+        f"session spans span {len(trace_ids)} traces, want 1"
+    )
+    return {"trace_id": trace_id, "parties": sorted(parties)}
+
+
+def check_metrics_scrape(server) -> dict:
+    """A worker's metrics port serves non-empty Prometheus text with
+    worker-plan and networking counters (retry/chaos counters join
+    after the chaos run — same process-global registry)."""
+    import urllib.request
+
+    port = server.metrics_server.port
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ).read().decode()
+    assert text.strip(), "empty Prometheus scrape"
+    for needle in (
+        "moose_tpu_worker_plans_built_total",
+        "moose_tpu_net_tx_bytes_total",
+        "moose_tpu_net_send_many_total",
+    ):
+        assert needle in text, f"scrape missing {needle}"
+    return {"port": port, "bytes": len(text)}
+
+
+def run_chaos_kill_flight(traced, x) -> dict:
+    """Kill one party mid-session under the deterministic chaos layer;
+    the terminal report must attach the killed party's flight events,
+    and retry/chaos counters must land on the registry."""
+    from moose_tpu import metrics
+    from moose_tpu.distributed.chaos import ChaosConfig
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    retries_before = metrics.REGISTRY.value(
+        "moose_tpu_client_retries_total"
+    )
+    chaos = ChaosConfig(seed=1, kill_after_ops=1, party="carole")
+    servers = {}
+    # eager workers: this run is about failure propagation + flight
+    # capture, not the compiled plan — skip the fresh cluster's
+    # re-validation compiles
+    os.environ["MOOSE_TPU_WORKER_JIT"] = "0"
+    try:
+        servers, endpoints = start_local_cluster(
+            ("alice", "bob", "carole"), ping_interval=0.25,
+            ping_misses=2, startup_grace=5.0, receive_timeout=30.0,
+            chaos=chaos, metrics_port=0,
+        )
+        runtime = GrpcClientRuntime(
+            endpoints, max_attempts=2, backoff_base_s=0.05,
+            backoff_cap_s=0.2,
+        )
+        failed = False
+        try:
+            runtime.run_computation(traced, {"x": x}, timeout=60.0)
+        except Exception:
+            failed = True
+        assert failed, "chaos-killed session unexpectedly succeeded"
+        report = runtime.last_session_report
+        events = report.get("flight") or []
+        assert events, "terminal failure attached no flight events"
+        parties = {e.get("party") for e in events}
+        assert "carole" in parties, (
+            f"killed party's events missing from flight: {parties}"
+        )
+        carole_kinds = {
+            e["kind"] for e in events if e.get("party") == "carole"
+        }
+        assert "chaos_kill" in carole_kinds, carole_kinds
+        assert metrics.REGISTRY.value(
+            "moose_tpu_chaos_injections_total", kind="kill"
+        ) >= 1
+        assert metrics.REGISTRY.value(
+            "moose_tpu_client_retries_total"
+        ) > retries_before, "retry counter did not advance"
+        # the acceptance wording in full: a worker scrape AFTER the
+        # failure carries retry and chaos counters too (alice is alive;
+        # the registry is process-global)
+        import urllib.request
+
+        text = urllib.request.urlopen(
+            "http://127.0.0.1:"
+            f"{servers['alice'].metrics_server.port}/metrics",
+            timeout=10,
+        ).read().decode()
+        for needle in (
+            "moose_tpu_client_retries_total",
+            'moose_tpu_chaos_injections_total{kind="kill"}',
+        ):
+            assert needle in text, f"post-chaos scrape missing {needle}"
+        return {
+            "flight_events": len(events),
+            "killed_party_events": sum(
+                1 for e in events if e.get("party") == "carole"
+            ),
+            "attempts": report["n_attempts"],
+        }
+    finally:
+        os.environ["MOOSE_TPU_WORKER_JIT"] = "1"
+        for srv in servers.values():
+            srv.stop()
 
 
 def build_logreg():
@@ -85,7 +268,7 @@ def main() -> int:
     summary = {}
     try:
         servers, endpoints = start_local_cluster(
-            ("alice", "bob", "carole")
+            ("alice", "bob", "carole"), metrics_port=0
         )
 
         runtime = GrpcClientRuntime(endpoints)
@@ -138,19 +321,48 @@ def main() -> int:
         # they agree to protocol precision, not bitwise
         assert err_local < 1e-2, f"distributed vs in-process: {err_local}"
 
-        summary = {
-            "ok": True,
-            "plan_modes": {p: m["plan_mode"] for p, m in modes.items()},
-            "validating_last_session": validating_last,
-            "plan_stats": stats_after,
-            "max_err_vs_sklearn": float(err_sk),
-            "max_err_vs_inprocess": float(err_local),
-        }
-        print(json.dumps(summary), flush=True)
-        return 0
+        # --- ISSUE 6 observability gates --------------------------------
+        # one more session with OTLP export on: the plan caches are warm,
+        # so this session's spans are purely the trace under test
+        from moose_tpu import telemetry
+
+        collector = _Collector()
+        try:
+            exporter = telemetry.configure_otlp(collector.endpoint)
+            runtime.run_computation(traced, {"x": x}, timeout=300.0)
+            assert exporter.flush(timeout_s=15.0), "otlp flush timed out"
+            assert exporter.dropped == 0, (
+                f"exporter dropped spans: {exporter.last_error}"
+            )
+            stitched = check_stitched_trace(collector)
+        finally:
+            telemetry.disable_otlp()
+            collector.close()
+
+        # Prometheus scrape off a worker's metrics port
+        scrape = check_metrics_scrape(servers["alice"])
     finally:
         for srv in servers.values():
             srv.stop()
+
+    # chaos-kill postmortem: flight events of the killed party reach
+    # last_session_report["flight"] (fresh cluster; the clean one above
+    # is already stopped so its ports/ids can't interfere)
+    flight_summary = run_chaos_kill_flight(traced, x)
+
+    summary = {
+        "ok": True,
+        "plan_modes": {p: m["plan_mode"] for p, m in modes.items()},
+        "validating_last_session": validating_last,
+        "plan_stats": stats_after,
+        "max_err_vs_sklearn": float(err_sk),
+        "max_err_vs_inprocess": float(err_local),
+        "stitched_trace": stitched,
+        "metrics_scrape": scrape,
+        "chaos_flight": flight_summary,
+    }
+    print(json.dumps(summary), flush=True)
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,205 @@
+"""Session flight recorder (moose_tpu/flight.py): the bounded event
+ring, JSONL streaming, the GetFlight rpc, and the client supervisor's
+postmortem attachment — a chaos-killed session's report must carry the
+killed party's events (ISSUE 6 acceptance)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+# one process/trust domain: the weak default PRF is acceptable here
+# (see test_distributed.py; worker.execute_role enforces the real rule)
+os.environ.setdefault("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
+
+import moose_tpu as pm
+from moose_tpu import flight
+from moose_tpu.edsl import tracer
+from moose_tpu.flight import FlightRecorder
+
+
+def test_ring_is_bounded():
+    rec = FlightRecorder(capacity=16, stream_path=None)
+    for i in range(100):
+        rec.record("tick", n=i)
+    events = rec.events()
+    assert len(events) == 16
+    # oldest first, newest retained
+    assert events[0]["n"] == 84 and events[-1]["n"] == 99
+    # seq keeps counting past evictions
+    assert events[-1]["seq"] == 100
+
+
+def test_event_shape_and_filtering():
+    rec = FlightRecorder(capacity=64, stream_path=None)
+    rec.record("launch", party="alice", session="s1")
+    rec.record("send", party="alice", session="s1", receiver="bob")
+    rec.record("launch", party="bob", session="s2")
+    rec.record("orphan")  # no session stamp
+    assert [e["kind"] for e in rec.events(session="s1")] == [
+        "launch", "send",
+    ]
+    assert rec.events(sessions=["s1", "s2"], party="bob")[0]["party"] == (
+        "bob"
+    )
+    assert len(rec.events()) == 4
+    assert rec.events(limit=2)[0]["kind"] == "launch"
+    ev = rec.events(session="s1")[0]
+    assert ev["seq"] == 1 and ev["ts"] > 0
+    rec.clear()
+    assert rec.events() == []
+
+
+def test_jsonl_streaming(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(capacity=8, stream_path=str(path))
+    rec.record("a", party="alice", session="s1")
+    rec.record("b", n=2)
+    rec.close()
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    first = json.loads(lines[0])
+    assert first["kind"] == "a" and first["party"] == "alice"
+    # the stream is append-only across recorder instances
+    rec2 = FlightRecorder(capacity=8, stream_path=str(path))
+    rec2.record("c")
+    rec2.close()
+    assert len(path.read_text().strip().splitlines()) == 3
+
+
+def test_stream_failure_never_raises(tmp_path):
+    rec = FlightRecorder(
+        capacity=8, stream_path=str(tmp_path / "nodir" / "f.jsonl")
+    )
+    rec.record("a")  # unwritable path: swallowed, ring still works
+    assert rec.events()[0]["kind"] == "a"
+
+
+def test_env_knobs(monkeypatch, tmp_path):
+    path = tmp_path / "env.jsonl"
+    monkeypatch.setenv("MOOSE_TPU_FLIGHT", str(path))
+    monkeypatch.setenv("MOOSE_TPU_FLIGHT_CAP", "32")
+    rec = FlightRecorder()
+    assert rec.capacity == 32
+    rec.record("hello")
+    rec.close()
+    assert json.loads(path.read_text())["kind"] == "hello"
+
+
+# ---------------------------------------------------------------------------
+# distributed postmortem: GetFlight rpc + chaos-kill report attachment
+# ---------------------------------------------------------------------------
+
+
+def _players():
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    return alice, bob, carole, rep
+
+
+def _secure_dot_comp():
+    alice, bob, carole, rep = _players()
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    return comp
+
+
+def _args():
+    rng = np.random.default_rng(0)
+    return {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+
+
+def test_get_flight_rpc_serves_session_events():
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    servers, endpoints = start_local_cluster(
+        ("alice", "bob", "carole"), ping_interval=0.25,
+        receive_timeout=30.0,
+    )
+    try:
+        runtime = GrpcClientRuntime(endpoints, max_attempts=1)
+        runtime.run_computation(
+            tracer.trace(_secure_dot_comp()), _args(), timeout=60.0
+        )
+        session_id = runtime.last_session_report["attempts"][0][
+            "session_id"
+        ]
+        events = runtime._clients["alice"].flight([session_id])
+        kinds = {e["kind"] for e in events}
+        assert "launch" in kinds, kinds
+        assert "session_completed" in kinds, kinds
+        assert all(e.get("session") == session_id for e in events)
+        # a successful run attaches no postmortem
+        assert "flight" not in runtime.last_session_report
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_chaos_killed_session_report_carries_flight_events():
+    """ISSUE 6 acceptance: on terminal failure the report's ``flight``
+    key holds every party's recent events for the failed session —
+    including the chaos-killed party, whose rpc endpoint is gone but
+    whose events live in the in-process recorder."""
+    from moose_tpu.distributed.chaos import ChaosConfig
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    chaos = ChaosConfig(seed=1, kill_after_ops=1, party="carole")
+    servers, endpoints = start_local_cluster(
+        ("alice", "bob", "carole"), ping_interval=0.25, ping_misses=2,
+        startup_grace=5.0, receive_timeout=30.0, chaos=chaos,
+    )
+    try:
+        runtime = GrpcClientRuntime(endpoints, max_attempts=1)
+        with pytest.raises(Exception):
+            runtime.run_computation(
+                tracer.trace(_secure_dot_comp()), _args(), timeout=60.0
+            )
+        report = runtime.last_session_report
+        assert report["ok"] is False
+        events = report.get("flight")
+        assert events, "terminal failure must attach flight events"
+        session_id = report["attempts"][-1]["session_id"]
+        assert all(
+            e.get("session") in {a["session_id"]
+                                 for a in report["attempts"]}
+            for e in events
+        )
+        kinds_by_party = {}
+        for e in events:
+            kinds_by_party.setdefault(e.get("party"), set()).add(e["kind"])
+        # the KILLED party's events are present
+        assert "carole" in kinds_by_party, kinds_by_party
+        assert "launch" in kinds_by_party["carole"]
+        assert "chaos_kill" in kinds_by_party["carole"], kinds_by_party
+        # the client's own lifecycle rides along
+        assert "attempt" in kinds_by_party.get("client", set())
+        assert "session_failed" in kinds_by_party["client"]
+        # events are time-ordered
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)
+        assert any(
+            e.get("session") == session_id for e in events
+        )
+    finally:
+        for srv in servers.values():
+            srv.stop()

@@ -1,0 +1,241 @@
+"""Unified metrics registry (moose_tpu/metrics.py): counter / gauge /
+histogram semantics, Prometheus text exposition, the HTTP scrape
+endpoint, and the bridges from the pre-existing ad-hoc counters
+(ServingMetrics, worker_plan.PLAN_STATS, chaos fault log)."""
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from moose_tpu import metrics
+from moose_tpu.metrics import MetricsRegistry
+
+
+# fresh registries per test: the GLOBAL registry accumulates across the
+# whole process, so tests on it assert deltas only
+@pytest.fixture()
+def registry():
+    return MetricsRegistry()
+
+
+def test_counter_inc_and_labels(registry):
+    c = registry.counter("t_requests_total", "requests", ("method",))
+    c.inc(method="get")
+    c.inc(2, method="post")
+    assert c.value(method="get") == 1
+    assert c.value(method="post") == 2
+    # unknown label value starts at 0, never raises
+    assert c.value(method="put") == 0
+    with pytest.raises(ValueError):
+        c.inc(-1, method="get")
+    with pytest.raises(ValueError):
+        c.inc(bogus="x")
+
+
+def test_gauge_set_inc_dec(registry):
+    g = registry.gauge("t_depth", "queue depth")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+
+
+def test_metric_identity_is_get_or_create(registry):
+    a = registry.counter("t_hits_total", "hits")
+    b = registry.counter("t_hits_total", "hits")
+    assert a is b
+    with pytest.raises(ValueError):
+        registry.gauge("t_hits_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        registry.counter("t_hits_total", labels=("x",))  # label mismatch
+    with pytest.raises(ValueError):
+        registry.counter("bad name")
+    with pytest.raises(ValueError):
+        registry.counter("ok_total", labels=("bad-label",))
+
+
+def test_prometheus_text_format(registry):
+    c = registry.counter("t_sends_total", "sends by wire", ("transport",))
+    c.inc(3, transport="grpc")
+    g = registry.gauge("t_temp", "temperature")
+    g.set(1.5)
+    text = registry.render_prometheus()
+    assert "# HELP t_sends_total sends by wire" in text
+    assert "# TYPE t_sends_total counter" in text
+    assert 't_sends_total{transport="grpc"} 3' in text
+    assert "# TYPE t_temp gauge" in text
+    assert "t_temp 1.5" in text
+    # every non-comment line parses as `name{labels} value`
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert re.match(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$", line
+        ), line
+
+
+def test_label_value_escaping(registry):
+    c = registry.counter("t_odd_total", "", ("path",))
+    c.inc(path='a"b\\c\nd')
+    text = registry.render_prometheus()
+    assert 'path="a\\"b\\\\c\\nd"' in text
+
+
+def test_histogram_buckets_cumulative(registry):
+    h = registry.histogram(
+        "t_latency_seconds", "latency", buckets=(0.1, 1.0, 10.0)
+    )
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = registry.render_prometheus()
+    assert 't_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 't_latency_seconds_bucket{le="1"} 2' in text
+    assert 't_latency_seconds_bucket{le="10"} 3' in text
+    assert 't_latency_seconds_bucket{le="+Inf"} 4' in text
+    assert "t_latency_seconds_count 4" in text
+    snap = registry.snapshot()
+    assert snap["t_latency_seconds"]["values"][""]["count"] == 4
+
+
+def test_snapshot_is_jsonable(registry):
+    registry.counter("t_a_total", "a").inc()
+    registry.histogram("t_h", "h", labels=("k",)).observe(1.0, k="x")
+    blob = json.dumps(registry.snapshot())
+    assert "t_a_total" in blob
+
+
+def test_concurrent_increments(registry):
+    c = registry.counter("t_conc_total", "")
+    n, per = 8, 500
+
+    def worker():
+        for _ in range(per):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == n * per
+
+
+def test_http_exposition_server():
+    registry = MetricsRegistry()
+    registry.counter("t_scrape_total", "scrapes").inc(7)
+    srv = metrics.MetricsServer(
+        0, registry=registry, health_extra={"identity": "alice"}
+    )
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
+        assert b"t_scrape_total 7" in text
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+        )
+        assert health == {"status": "ok", "identity": "alice"}
+        snap = json.loads(
+            urllib.request.urlopen(f"{base}/v1/metrics", timeout=5).read()
+        )
+        assert snap["t_scrape_total"]["values"][""] == 7
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# bridges onto the GLOBAL registry (delta assertions only)
+# ---------------------------------------------------------------------------
+
+
+def _global_value(name, **labels):
+    return metrics.REGISTRY.value(name, **labels)
+
+
+def test_serving_metrics_bridge():
+    from moose_tpu.serving.metrics import ServingMetrics
+
+    before_batches = _global_value("moose_tpu_serving_batches_total")
+    before_rows = _global_value("moose_tpu_serving_rows_total")
+    before_over = _global_value("moose_tpu_serving_overloads_total")
+    sm = ServingMetrics()
+    sm.record_batch(rows=3, bucket=4, retraced=False, validating=False)
+    sm.record_overload()
+    sm.record_latency(0.01, missed_deadline=True)
+    assert (
+        _global_value("moose_tpu_serving_batches_total")
+        == before_batches + 1
+    )
+    assert _global_value("moose_tpu_serving_rows_total") == before_rows + 3
+    assert (
+        _global_value("moose_tpu_serving_overloads_total")
+        == before_over + 1
+    )
+    # the windowed JSON surface is untouched by the bridge
+    snap = sm.snapshot()
+    assert snap["batches"] == 1 and snap["overloads"] == 1
+    # reset_window clears the window, NOT the monotone registry series
+    sm.reset_window()
+    assert (
+        _global_value("moose_tpu_serving_batches_total")
+        == before_batches + 1
+    )
+
+
+def test_worker_plan_stats_bridge():
+    from moose_tpu.distributed import worker_plan
+
+    before = _global_value("moose_tpu_worker_plans_built_total")
+    stats_before = worker_plan.plan_stats()["plans_built"]
+    worker_plan._stat("plans_built")
+    assert (
+        _global_value("moose_tpu_worker_plans_built_total") == before + 1
+    )
+    assert worker_plan.plan_stats()["plans_built"] == stats_before + 1
+
+
+def test_chaos_faults_bridge():
+    from moose_tpu.distributed.chaos import ChaosConfig
+
+    before = _global_value(
+        "moose_tpu_chaos_injections_total", kind="drop_send"
+    )
+    cfg = ChaosConfig(seed=3, drop_send=1.0)
+    cfg._record("drop_send", _session="s-1", key="k", party="alice")
+    assert (
+        _global_value("moose_tpu_chaos_injections_total", kind="drop_send")
+        == before + 1
+    )
+    # the determinism digest input (the fault log) carries NO session id
+    assert all("session" not in f for f in cfg.faults)
+
+
+def test_networking_counters_on_local_transport():
+    import numpy as np
+
+    from moose_tpu import dtypes
+    from moose_tpu.distributed.networking import LocalNetworking
+    from moose_tpu.values import HostTensor
+
+    tx_before = _global_value(
+        "moose_tpu_net_tx_bytes_total", transport="local"
+    )
+    rx_before = _global_value(
+        "moose_tpu_net_receives_total", transport="local"
+    )
+    net = LocalNetworking()
+    value = HostTensor(np.ones((2, 2)), "alice", dtypes.float64)
+    net.send(value, "bob", "rdv-1", "sess-m")
+    net.receive("alice", "rdv-1", "sess-m", plc="bob", timeout=5.0)
+    assert (
+        _global_value("moose_tpu_net_tx_bytes_total", transport="local")
+        > tx_before
+    )
+    assert (
+        _global_value("moose_tpu_net_receives_total", transport="local")
+        == rx_before + 1
+    )

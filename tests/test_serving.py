@@ -331,6 +331,47 @@ def test_predictor_factory_memoized_no_retrace():
     assert "trace" not in rt.last_timings  # ...fresh factory call: hit
 
 
+def test_blitzen_http_metrics_endpoints():
+    """GET /metrics serves Prometheus text from the unified registry
+    (queue-depth gauge refreshed at scrape) while /v1/metrics keeps the
+    JSON snapshot (ISSUE 6 tentpole b)."""
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from moose_tpu.bin.blitzen import _make_handler
+
+    model, _ = _logreg_model()
+    config = ServingConfig(max_batch=4, max_wait_ms=1.0, queue_bound=8)
+    with InferenceServer(config=config) as server:
+        server.register_model("logreg", model, row_shape=(6,))
+        server.predict("logreg", RNG.normal(size=(6,)), timeout_s=120.0)
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), _make_handler(server)
+        )
+        import threading
+
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_port}"
+            text = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10
+            ).read().decode()
+            assert "# TYPE moose_tpu_serving_batches_total counter" in text
+            assert 'moose_tpu_serving_queue_depth{model="logreg"}' in text
+            assert "moose_tpu_serving_request_latency_seconds_bucket" in (
+                text
+            )
+            snap = json.loads(urllib.request.urlopen(
+                f"{base}/v1/metrics", timeout=10
+            ).read())
+            assert snap["rows_served"] >= 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
 def test_blitzen_oneshot(tmp_path):
     model_src, sk = _logreg_model()
     onnx_path = tmp_path / "logreg.onnx"

@@ -296,6 +296,199 @@ def test_otlp_collector_down_never_raises():
         telemetry.disable_otlp()
 
 
+def test_trace_context_ids_and_adoption():
+    """Spans carry stable ids; roots under an ambient TraceContext join
+    its trace instead of minting an orphan one."""
+    with telemetry.span("orphan") as orphan:
+        pass
+    assert len(orphan.trace_id) == 32 and len(orphan.span_id) == 16
+    assert orphan.parent_span_id is None
+
+    ctx = telemetry.TraceContext.new()
+    with telemetry.use_context(ctx):
+        assert telemetry.current_context() == ctx
+        with telemetry.span("root") as root:
+            inner = telemetry.current_context()
+            assert inner.trace_id == ctx.trace_id
+            assert inner.span_id == root.span_id
+            with telemetry.span("child") as child:
+                pass
+    # restored after the context manager
+    assert telemetry.current_context() is None
+    assert root.trace_id == ctx.trace_id
+    assert root.parent_span_id == ctx.span_id
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_span_id == root.span_id
+    assert child.span_id != root.span_id
+
+    # wire round-trip
+    assert telemetry.TraceContext.from_dict(ctx.to_dict()) == ctx
+    assert telemetry.TraceContext.from_dict(None) is None
+    assert telemetry.TraceContext.from_dict({"trace_id": ""}) is None
+
+
+def test_background_thread_inherits_context():
+    import threading
+
+    ctx = telemetry.TraceContext.new()
+    captured = {}
+
+    def worker():
+        with telemetry.use_context(ctx):
+            with telemetry.span("bg-root") as s:
+                pass
+            captured["span"] = s
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert captured["span"].trace_id == ctx.trace_id
+    assert captured["span"].parent_span_id == ctx.span_id
+
+
+def test_otlp_encode_uses_propagated_ids():
+    """The exporter ships the spans' own (propagated) ids — not fresh
+    random ones per encode — so two processes exporting halves of one
+    session produce ONE stitched trace."""
+    ctx = telemetry.TraceContext.new()
+    with telemetry.use_context(ctx):
+        with telemetry.span("root") as root:
+            with telemetry.span("child"):
+                pass
+    exporter = telemetry.OtlpExporter.__new__(telemetry.OtlpExporter)
+    exporter.service_name = "svc"
+    payload = exporter.encode(root)
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["root"]["traceId"] == ctx.trace_id
+    assert by_name["root"]["spanId"] == root.span_id
+    # the remote parent (the propagated context's span) is preserved
+    assert by_name["root"]["parentSpanId"] == ctx.span_id
+    assert by_name["child"]["traceId"] == ctx.trace_id
+    # a second encode of the same tree yields the SAME ids
+    payload2 = exporter.encode(root)
+    spans2 = payload2["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert {s["spanId"] for s in spans} == {s["spanId"] for s in spans2}
+
+
+def test_otlp_flush_never_blocks_on_full_queue():
+    """Satellite: flush() on a wedged full queue must time out and
+    return False — a blocking put would park the caller forever."""
+    import threading
+    import time
+
+    release = threading.Event()
+
+    class _Wedged(telemetry.OtlpExporter):
+        def _post(self, payload):
+            release.wait(30.0)
+
+    exporter = _Wedged("http://127.0.0.1:9", max_queue=2)
+    try:
+        for _ in range(4):  # 1 in-flight (blocked in _post) + 2 queued
+            with telemetry.span("r"):
+                pass
+            exporter.export(telemetry.last_trace())
+        t0 = time.monotonic()
+        ok = exporter.flush(timeout_s=0.5)
+        elapsed = time.monotonic() - t0
+        assert ok is False
+        assert elapsed < 5.0, f"flush blocked for {elapsed:.1f}s"
+        assert exporter.dropped >= 1  # the overflow export was dropped
+    finally:
+        release.set()
+        exporter.shutdown()
+
+
+def test_distributed_session_exports_one_stitched_trace(monkeypatch):
+    """ISSUE 6 acceptance: a 3-party gRPC session with OTLP configured
+    exports exactly ONE trace id shared by the client spans and every
+    worker's execute_role span, with parent/child span ids lining up
+    across the rpc boundary."""
+    monkeypatch.setenv("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def comp(
+        x: pm.Argument(placement=alice, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with alice:
+            xf = pm.cast(x, dtype=pm.fixed(14, 23))
+        with bob:
+            wf = pm.cast(w, dtype=pm.fixed(14, 23))
+        with rep:
+            y = pm.dot(xf, wf)
+        with carole:
+            out = pm.cast(y, dtype=pm.float64)
+        return out
+
+    from moose_tpu.edsl import tracer
+
+    rng = np.random.default_rng(0)
+    args = {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+
+    collector = _Collector()
+    servers = {}
+    try:
+        exporter = telemetry.configure_otlp(collector.endpoint)
+        servers, endpoints = start_local_cluster(
+            ("alice", "bob", "carole"), ping_interval=0.25,
+            receive_timeout=30.0,
+        )
+        runtime = GrpcClientRuntime(endpoints, max_attempts=1)
+        runtime.run_computation(
+            tracer.trace(comp), args, timeout=60.0
+        )
+        assert exporter.flush(timeout_s=10.0)
+    finally:
+        telemetry.disable_otlp()
+        for srv in servers.values():
+            srv.stop()
+        collector.close()
+
+    spans = []
+    for _, payload in collector.requests:
+        for rs in payload["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                spans.extend(ss["spans"])
+    by_name: dict = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    roots = by_name["run_computation"]
+    assert len(roots) == 1
+    trace_id = roots[0]["traceId"]
+    workers = by_name.get("execute_role", [])
+    parties = set()
+    for s in workers:
+        attrs = {a["key"]: a["value"] for a in s["attributes"]}
+        parties.add(attrs["party"]["stringValue"])
+    assert parties == {"alice", "bob", "carole"}, parties
+    # ONE stitched trace: every span of client AND workers shares it
+    session_span_names = {
+        "run_computation", "attempt", "launch", "retrieve",
+        "execute_role", "worker_segment",
+    }
+    for s in spans:
+        if s["name"] in session_span_names:
+            assert s["traceId"] == trace_id, (s["name"], s["traceId"])
+    # parent/child line up across the rpc: each worker root hangs off
+    # the client's attempt span
+    (attempt,) = by_name["attempt"]
+    assert attempt["parentSpanId"] == roots[0]["spanId"]
+    for s in workers:
+        assert s["parentSpanId"] == attempt["spanId"], s
+    # exporter book-keeping
+    assert exporter.exported >= 4  # client root + 3 worker roots
+    assert exporter.dropped == 0
+
+
 def test_comet_telemetry_flag_wires_exporter(monkeypatch):
     """comet --telemetry ENDPOINT installs the OTLP exporter before the
     worker starts (reference comet.rs:30-41)."""
