@@ -260,6 +260,10 @@ def bench_distributed_logreg(batch=128, features=100, iters=4,
                 runtime.run_computation(traced, {"x": x}, timeout=600.0)
                 times.append(time.perf_counter() - t0)
             comms = _comms_delta(comms_before, _comms_snapshot(), iters)
+            if worker_jit:
+                comms["static"] = _static_comms_report(
+                    runtime, traced, comms
+                )
             return batch / float(np.median(times)), modes, comms
         finally:
             for srv in servers.values():
@@ -275,6 +279,38 @@ def bench_distributed_logreg(batch=128, features=100, iters=4,
         else:
             os.environ["MOOSE_TPU_WORKER_JIT"] = prev_jit
     return jit_per_sec, eager_per_sec, modes, comms
+
+
+def _static_comms_report(runtime, traced, comms: dict) -> dict:
+    """ISSUE 7: the static cost model's per-session predictions for the
+    computation the timed loop just ran, recorded alongside the
+    measured wire counters — plus a ``matches_measured`` flag (the hard
+    exact-equality gate lives in scripts/dist_smoke.py; the bench
+    reports drift as data, it must not die on it)."""
+    try:
+        from moose_tpu.compilation.analysis import cost_report
+
+        per_specs = runtime._compile_cache.get(traced) or {}
+        compiled = next(iter(per_specs.values()))[0]
+        totals = cost_report(compiled, transport="grpc")["totals"]
+        predicted = {
+            "tx_bytes_per_session": totals["tx_bytes"],
+            "rx_bytes_per_session": totals["rx_bytes"],
+            "single_sends_per_session": totals["sends"],
+            "coalesced_envelopes_per_session": totals[
+                "send_many_envelopes"
+            ],
+            "coalesced_payloads_per_session": totals[
+                "send_many_payloads"
+            ],
+        }
+        predicted["matches_measured"] = all(
+            abs(float(comms.get(k, -1)) - float(v)) < 0.5
+            for k, v in predicted.items()
+        )
+        return predicted
+    except Exception as e:  # noqa: BLE001 — report the failure as data
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def _comms_snapshot() -> dict:
@@ -414,8 +450,8 @@ def _bench_predictor(comp, args, check, batch, layout=None, iters=5,
     # per-op rung pinned eager) — recorded in the bench JSON so a
     # regression shows up as a mode flip, not just a slow number
     info = {
-        "plan_mode": runtime.last_timings.get("plan_mode"),
-        "pinned_ops": list(runtime.last_timings.get("pinned_ops", ())),
+        "plan_mode": runtime.last_plan.get("plan_mode"),
+        "pinned_ops": list(runtime.last_plan.get("pinned_ops", ())),
         "layout": runtime.last_plan.get("layout"),
         "window_medians": medians,
     }

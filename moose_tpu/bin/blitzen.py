@@ -85,7 +85,18 @@ def build_server(model_paths: dict, row_features: dict, args):
             raise SystemExit(
                 f"--features {name}={n_features}: N must be >= 1"
             )
-        server.register_model(name, model, row_shape=(n_features,))
+        from moose_tpu.errors import CompilationError
+
+        try:
+            server.register_model(name, model, row_shape=(n_features,))
+        except CompilationError as e:
+            # the registry's strict lint rejected the model (share
+            # leak, malformed rendezvous, would-deadlock plan, ...):
+            # a typed registration-time failure, not a serve-time hang
+            raise SystemExit(
+                f"model {name!r} failed the static lint at "
+                f"registration: {e}"
+            ) from e
     return server
 
 
@@ -93,7 +104,11 @@ def _make_handler(server):
     from concurrent.futures import TimeoutError as FutureTimeoutError
     from http.server import BaseHTTPRequestHandler
 
-    from moose_tpu.errors import ConfigurationError, ServerOverloadedError
+    from moose_tpu.errors import (
+        CompilationError,
+        ConfigurationError,
+        ServerOverloadedError,
+    )
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -186,8 +201,11 @@ def _make_handler(server):
                 self._reply(
                     504, {"error": type(e).__name__, "message": str(e)}
                 )
-            except (ConfigurationError, KeyError, ValueError,
-                    json.JSONDecodeError) as e:
+            except (CompilationError, ConfigurationError, KeyError,
+                    ValueError, json.JSONDecodeError) as e:
+                # CompilationError covers the registry's strict lint
+                # (MalformedComputationError with MSA diagnostics): a
+                # bad model is the CLIENT's fault — 4xx, not 500
                 self._reply(
                     400, {"error": type(e).__name__, "message": str(e)}
                 )

@@ -2,17 +2,31 @@
 
 Runs the graph analyses of :mod:`moose_tpu.compilation.analysis` —
 secrecy/information-flow (MSA1xx), communication pairing/deadlock
-(MSA2xx), signature consistency (MSA3xx), graph hygiene (MSA4xx) — over
-one or more computation files (textual ``.moose`` or msgpack, like the
-rest of the reindeer tool family) and reports every finding.  Exit
+(MSA2xx), signature consistency (MSA3xx), graph hygiene (MSA4xx),
+execution-plan schedule (MSA5xx), communication/memory cost (MSA6xx) —
+over one or more computation files (textual ``.moose`` or msgpack, like
+the rest of the reindeer tool family) and reports every finding.  Exit
 status is 1 if any error-severity diagnostic fired (add
 ``--strict-warnings`` to also fail on warnings), so it slots directly
 into CI.
+
+``--schedule`` and ``--cost`` additionally emit the machine-readable
+plan report for lowered/networked graphs: per-role segment schedules
+reconstructed with the worker's own segmentation rules, and the static
+cost model's per-party wire counters (tx/rx bytes, ``send_many``
+envelope/payload counts after coalescing) plus per-segment live-buffer
+high-water-marks.  ``--role`` filters the report to one role;
+``--arg-shape name=16x8`` pins an Input/Load shape the model cannot
+infer; ``--session-id`` sets the id whose length prices the transfer
+keys (byte counts depend only on its length; the client mints
+32-hex-char ids, the default).
 
 Examples:
   python -m moose_tpu.bin.prancer comp.moose
   python -m moose_tpu.bin.prancer lowered.bin --analyses communication,hygiene
   python -m moose_tpu.bin.prancer comp.moose --passes typing,prune --format json
+  python -m moose_tpu.bin.prancer lowered.bin --schedule --cost --role alice \
+      --format json
   python -m moose_tpu.bin.prancer --explain          # rule catalogue
 """
 
@@ -23,8 +37,28 @@ import json
 import sys
 
 
-def _lint_file(path: str, args) -> list:
-    from moose_tpu.compilation.analysis import analyze
+def _parse_arg_shapes(pairs) -> dict:
+    """``name=16x8`` (or ``name=16,8``) -> {name: (16, 8)}."""
+    out = {}
+    for pair in pairs or ():
+        if "=" not in pair:
+            raise SystemExit(
+                f"--arg-shape expects name=DIMxDIM..., got {pair!r}"
+            )
+        name, _, dims = pair.partition("=")
+        seps = dims.replace(",", "x")
+        try:
+            out[name] = tuple(
+                int(d) for d in seps.split("x") if d != ""
+            )
+        except ValueError:
+            raise SystemExit(
+                f"--arg-shape expects integer dims, got {pair!r}"
+            ) from None
+    return out
+
+
+def _load(path: str, args):
     from moose_tpu.serde import load_computation
 
     comp = load_computation(path)
@@ -33,11 +67,61 @@ def _lint_file(path: str, args) -> list:
 
         passes = [p for p in args.passes.split(",") if p]
         comp = compile_computation(comp, passes)
+    return comp
+
+
+def _lint(comp, args) -> list:
+    from moose_tpu.compilation.analysis import analyze
+
     analyses = None
     if args.analyses:
         analyses = [a for a in args.analyses.split(",") if a]
     ignore = [r for r in (args.ignore or "").split(",") if r]
     return analyze(comp, analyses=analyses, ignore=ignore)
+
+
+def _plan_report(comp, args) -> dict:
+    """The ``--schedule``/``--cost`` report for one computation."""
+    from moose_tpu.compilation.analysis import (
+        cost_report,
+        reconstruct_schedules,
+    )
+    from moose_tpu.compilation.analysis.schedule import _analyzable
+
+    report: dict = {}
+    if not _analyzable(comp):
+        report["analyzable"] = False
+        return report
+    report["analyzable"] = True
+    all_schedules = reconstruct_schedules(comp)
+    schedules = all_schedules
+    if args.role:
+        if args.role not in schedules:
+            raise SystemExit(
+                f"--role {args.role!r} not in this computation; roles: "
+                f"{sorted(schedules)}"
+            )
+        schedules = {args.role: schedules[args.role]}
+    if args.schedule:
+        report["schedule"] = {
+            role: sched.summary() for role, sched in schedules.items()
+        }
+    if args.cost:
+        cost = cost_report(
+            comp,
+            session_id=args.session_id,
+            arg_specs=_parse_arg_shapes(args.arg_shape) or None,
+            transport=args.transport,
+            # cost is cross-role even when the DISPLAY is filtered, so
+            # hand it the unfiltered schedules (no re-reconstruction)
+            schedules=all_schedules,
+        )
+        if args.role:
+            cost["per_party"] = {
+                args.role: cost["per_party"][args.role]
+            }
+        report["cost"] = cost
+    return report
 
 
 def main(argv=None) -> int:
@@ -53,7 +137,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--analyses", default=None,
         help="comma-separated analyses to run (default: all; "
-             "secrecy,communication,signatures,hygiene)",
+             "secrecy,communication,signatures,hygiene,schedule,cost)",
     )
     parser.add_argument(
         "--ignore", default=None,
@@ -74,6 +158,37 @@ def main(argv=None) -> int:
         help="exit nonzero on warnings too, not just errors",
     )
     parser.add_argument(
+        "--schedule", action="store_true",
+        help="emit each role's reconstructed worker-plan schedule "
+             "(segments, hoists, deferred flush groups)",
+    )
+    parser.add_argument(
+        "--cost", action="store_true",
+        help="emit the static cost report (per-party tx/rx bytes, "
+             "send_many envelopes/payloads, live-buffer high-water "
+             "marks)",
+    )
+    parser.add_argument(
+        "--role", default=None,
+        help="filter the --schedule/--cost report to one role",
+    )
+    parser.add_argument(
+        "--session-id", default="0" * 32,
+        help="session id used to price transfer keys (only its LENGTH "
+             "affects byte counts; default matches the client's "
+             "32-hex-char ids)",
+    )
+    parser.add_argument(
+        "--transport", choices=("grpc", "local"), default="grpc",
+        help="wire-envelope semantics for --cost (default grpc)",
+    )
+    parser.add_argument(
+        "--arg-shape", action="append", default=None,
+        metavar="NAME=16x8",
+        help="pin an Input/Load op's shape for the cost model "
+             "(repeatable)",
+    )
+    parser.add_argument(
         "--explain", action="store_true",
         help="print the rule catalogue and exit",
     )
@@ -91,12 +206,15 @@ def main(argv=None) -> int:
     threshold = (
         Severity.WARNING if args.strict_warnings else Severity.ERROR
     )
+    want_report = args.schedule or args.cost
     failed = False
     records = []
+    reports = {}
     counts = {s: 0 for s in Severity}
     for path in args.computations:
         try:
-            diagnostics = _lint_file(path, args)
+            comp = _load(path, args)
+            diagnostics = _lint(comp, args)
         except Exception as e:  # noqa: BLE001 — unloadable/uncompilable
             # file: report it and keep linting the rest of the batch
             failed = True
@@ -118,10 +236,28 @@ def main(argv=None) -> int:
                 records.append({"file": path, **d.to_dict()})
             else:
                 print(f"{path}: {d.format()}")
+        if want_report:
+            try:
+                reports[path] = _plan_report(comp, args)
+            except SystemExit:
+                raise
+            except Exception as e:  # noqa: BLE001 — report failure must
+                # not mask the lint verdict
+                reports[path] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
     if args.format == "json":
-        json.dump(records, sys.stdout, indent=2)
+        payload: object = records
+        if want_report:
+            payload = {"diagnostics": records, "reports": reports}
+        json.dump(payload, sys.stdout, indent=2)
         print()
     else:
+        if want_report:
+            for path, report in reports.items():
+                print(f"# {path} plan report")
+                json.dump(report, sys.stdout, indent=2)
+                print()
         print(
             f"{len(args.computations)} file(s): "
             f"{counts[Severity.ERROR]} error(s), "

@@ -20,8 +20,9 @@ Entry points:
   computations (textual or msgpack).
 
 Rule id space: ``MSA1xx`` secrecy, ``MSA2xx`` communication, ``MSA3xx``
-signatures, ``MSA4xx`` hygiene.  The full catalogue is in :data:`RULES`
-and documented in DEVELOP.md.
+signatures, ``MSA4xx`` hygiene, ``MSA5xx`` execution-plan schedule,
+``MSA6xx`` communication/memory cost.  The full catalogue is in
+:data:`RULES` and documented in DEVELOP.md.
 """
 
 from __future__ import annotations
@@ -32,6 +33,8 @@ from ...computation import Computation
 from ...errors import MalformedComputationError
 from .communication import RULES as _COMM_RULES
 from .communication import analyze_communication
+from .cost import RULES as _COST_RULES
+from .cost import analyze_cost, cost_report, infer_specs
 from .diagnostics import (
     Diagnostic,
     Severity,
@@ -40,6 +43,13 @@ from .diagnostics import (
 )
 from .hygiene import RULES as _HYGIENE_RULES
 from .hygiene import analyze_hygiene
+from .schedule import RULES as _SCHEDULE_RULES
+from .schedule import (
+    analyze_schedule,
+    build_role_schedule,
+    plan_errors,
+    reconstruct_schedules,
+)
 from .secrecy import RULES as _SECRECY_RULES
 from .secrecy import analyze_secrecy
 from .signatures import RULES as _SIG_RULES
@@ -47,7 +57,9 @@ from .signatures import analyze_signatures
 
 __all__ = [
     "ANALYSES", "Diagnostic", "RULES", "Severity", "analyze",
-    "format_diagnostics", "lint_check", "max_severity",
+    "analyze_cost", "analyze_schedule", "build_role_schedule",
+    "cost_report", "format_diagnostics", "infer_specs", "lint_check",
+    "max_severity", "plan_errors", "reconstruct_schedules",
 ]
 
 # name -> analysis function; the public registry (prancer's --analyses
@@ -57,11 +69,14 @@ ANALYSES = {
     "communication": analyze_communication,
     "signatures": analyze_signatures,
     "hygiene": analyze_hygiene,
+    "schedule": analyze_schedule,
+    "cost": analyze_cost,
 }
 
 # rule id -> one-line description (prancer --explain, DEVELOP.md).
 RULES = {
     **_SECRECY_RULES, **_COMM_RULES, **_SIG_RULES, **_HYGIENE_RULES,
+    **_SCHEDULE_RULES, **_COST_RULES,
 }
 
 

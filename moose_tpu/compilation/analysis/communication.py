@@ -30,7 +30,7 @@ from __future__ import annotations
 
 from collections import defaultdict
 
-from ...computation import Computation
+from ...computation import Computation, Operation
 from ..well_formed import rendezvous_attr_problems
 from .diagnostics import Diagnostic, Severity
 
@@ -40,7 +40,7 @@ def analyze_communication(comp: Computation) -> list[Diagnostic]:
     sends: dict[str, list] = defaultdict(list)
     receives: dict[str, list] = defaultdict(list)
 
-    def check_attrs(op) -> bool:
+    def check_attrs(op: "Operation") -> bool:
         # same contract the fail-fast well_formed_check enforces,
         # collected instead of raised
         for problem in rendezvous_attr_problems(op, comp.placements):

@@ -39,7 +39,7 @@ _CSE_EXEMPT_KINDS = frozenset({
 })
 
 
-def _canonical(value):
+def _canonical(value: object) -> object:
     """Hashable structural key for an attribute value (ndarrays by
     content digest, containers recursively)."""
     if isinstance(value, np.ndarray):

@@ -84,6 +84,37 @@ def transfer_key(session_id: str, rendezvous_key: str) -> str:
     return f"{session_id}/{rendezvous_key}"
 
 
+def pack_value_frame(sender: str, key: str, payload: bytes) -> bytes:
+    """The single-payload SendValue frame.  Module-level (not a method)
+    so the static cost model (analysis/cost.py) can price a transfer
+    with the exact bytes the transport will emit — the frame layout has
+    one definition."""
+    import msgpack
+
+    return msgpack.packb(
+        {"key": key, "sender": sender, "value": payload},
+        use_bin_type=True,
+    )
+
+
+def pack_batch_frame(sender: str, entries) -> bytes:
+    """The coalesced send_many envelope: ``entries`` is
+    ``[(transfer_key, payload_bytes), ...]`` — one rpc carrying several
+    rendezvous payloads of one session.  Shared with the cost model
+    like :func:`pack_value_frame`."""
+    import msgpack
+
+    return msgpack.packb(
+        {
+            "sender": sender,
+            "batch": [
+                {"key": key, "value": payload} for key, payload in entries
+            ],
+        },
+        use_bin_type=True,
+    )
+
+
 class ProgressClock:
     """Monotonic liveness marker shared by a worker's ops: every local op
     completion (and, on gRPC workers, every successful peer ping) bumps
@@ -595,17 +626,12 @@ class GrpcNetworking:
 
     def send(self, value, receiver: str, rendezvous_key: str,
              session_id: str):
-        import msgpack
-
         from ..serde import serialize_value
 
-        frame = msgpack.packb(
-            {
-                "key": transfer_key(session_id, rendezvous_key),
-                "sender": self._identity,
-                "value": serialize_value(value),
-            },
-            use_bin_type=True,
+        frame = pack_value_frame(
+            self._identity,
+            transfer_key(session_id, rendezvous_key),
+            serialize_value(value),
         )
         m = _net_metrics()
         m["sends"].inc(transport="grpc")
@@ -618,22 +644,14 @@ class GrpcNetworking:
         coalesces same-destination sends at segment boundaries so a
         protocol round costs one envelope per peer instead of one rpc
         per tensor."""
-        import msgpack
-
         from ..serde import serialize_value
 
-        frame = msgpack.packb(
-            {
-                "sender": self._identity,
-                "batch": [
-                    {
-                        "key": transfer_key(session_id, key),
-                        "value": serialize_value(value),
-                    }
-                    for key, value in items
-                ],
-            },
-            use_bin_type=True,
+        frame = pack_batch_frame(
+            self._identity,
+            [
+                (transfer_key(session_id, key), serialize_value(value))
+                for key, value in items
+            ],
         )
         m = _net_metrics()
         m["send_many"].inc(transport="grpc")

@@ -253,15 +253,32 @@ def execute_role(
     # Send/Receive boundaries into validated-jit compute segments, sends
     # go async, receives prefetch — the legacy per-op parallel scheduler
     # below remains the eager fallback (MOOSE_TPU_WORKER_JIT=0, aes-ctr
-    # PRF, disabled self-check)
+    # PRF, disabled self-check, or an MSA5xx build-time plan rejection)
     from . import worker_plan
 
     if worker_plan.use_fast_path():
-        return worker_plan.execute_role_planned(
-            comp, identity, storage, arguments, networking, session_id,
-            timeout, cancel, progress,
-            worker_plan.get_plan(comp, identity, session_id=session_id),
-        )
+        from ..errors import PlanRejectedError
+        from ..logger import get_logger
+
+        try:
+            plan = worker_plan.get_plan(
+                comp, identity, session_id=session_id
+            )
+        except PlanRejectedError as e:
+            # the schedule analyzer proved the sequential plan would
+            # hang; the dependency-driven legacy scheduler below is not
+            # subject to the plan's step ordering, so demote instead of
+            # failing the session
+            get_logger().warning(
+                "worker plan for %s rejected by the schedule analyzer; "
+                "falling back to the legacy eager scheduler: %s",
+                identity, e,
+            )
+        else:
+            return worker_plan.execute_role_planned(
+                comp, identity, storage, arguments, networking,
+                session_id, timeout, cancel, progress, plan,
+            )
 
     sess = EagerSession(session_id=session_id)
     env: dict = {}

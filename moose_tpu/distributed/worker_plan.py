@@ -30,11 +30,20 @@ This module gives ``execute_role`` a compiled plan instead:
   (mirroring the PR-2 plan registry), so repeat sessions — serving
   traffic through comet — never re-validate and never re-jit;
 - **communication overlaps compute**: Sends enqueue on a background
-  sender thread at segment boundaries (consecutive same-destination
-  payloads coalesce into one ``send_many`` envelope where the transport
-  supports it) while the next segment executes, and all Receives are
-  posted up front so the poller prefetches arriving payloads into
-  segment input slots before the orchestrator needs them.
+  sender thread at segment boundaries — each segment's deferred flush
+  group buckets per receiver and every >=2-payload bucket coalesces
+  into one ``send_many`` envelope, DETERMINISTICALLY (plan-driven, so
+  the static cost model in ``compilation/analysis/cost.py`` predicts
+  envelope counts and wire bytes exactly) — while the next segment
+  executes, and all Receives are posted up front so the poller
+  prefetches arriving payloads into segment input slots before the
+  orchestrator needs them;
+- plans are **statically vetted before they run**: the schedule
+  skeleton comes from ``compilation.analysis.schedule`` (the MSA5xx
+  analyzer reconstructs the identical plan), and :func:`get_plan`
+  raises the typed :class:`~moose_tpu.errors.PlanRejectedError` on
+  would-hang plans — the worker demotes to the legacy eager scheduler
+  instead of blocking at runtime.
 
 Chaos compatibility: fault schedules key on the same stable rendezvous
 keys — :class:`~.chaos.ChaosNetworking` decomposes ``send_many`` back
@@ -56,62 +65,31 @@ import weakref
 from collections import deque
 from typing import Any, Callable, Optional
 
-from ..errors import NetworkingError, SessionAbortedError
+from ..compilation.analysis.schedule import (
+    DEFERRABLE_KINDS as _DEFERRABLE_KINDS,
+)
+from ..compilation.analysis.schedule import (
+    DYNAMIC_SHAPE_KINDS as _DYNAMIC_SHAPE_KINDS,
+)
+from ..compilation.analysis.schedule import (
+    HOISTABLE_KINDS as _HOISTABLE_KINDS,
+)
+from ..compilation.analysis.schedule import (
+    HOST_STEP_KINDS as _HOST_STEP_KINDS,
+)
+from ..compilation.analysis.schedule import MAX_DEFERRED as _MAX_DEFERRED
+from ..compilation.analysis.schedule import (
+    build_role_schedule,
+    worker_min_seg as _min_seg,
+)
+from ..errors import NetworkingError, PlanRejectedError, SessionAbortedError
 
-# Kinds the orchestrator resolves on the host side, OUTSIDE compute
-# segments: I/O boundaries, communication, and entropy draws (PrfKeyGen /
-# Sample must stay eager — jitting them would bake one draw into the
-# compiled program and replay it forever).
-_HOST_STEP_KINDS = frozenset({
-    "Input", "Load", "Save", "Output", "Send", "Receive", "PrfKeyGen",
-    "Sample",
-})
-
-# Of those, only some actually FORCE a segment split.  A lowered
-# protocol graph interleaves communication with compute every few ops —
-# splitting at every host step would shatter a role into hundreds of
-# tiny XLA programs (measured ~300 for one logreg role), paying compile
-# and dispatch per fragment.  Instead:
-#  - HOISTABLE ops have no dataflow inputs (PrfKeyGen, Input): they
-#    execute BEFORE the merged segment, their values entering as
-#    ordinary segment inputs;
-#  - DEFERRABLE ops only consume values (Send, Save, Output): they
-#    execute right AFTER the merged segment that produces their
-#    operands.  A deferred Send still flushes before the next receive
-#    WAIT, so the deadlock argument is untouched — the orchestrator
-#    never blocks between a send's original position and its deferred
-#    flush;
-#  - HARD boundaries end the segment: Receive (the value arrives
-#    mid-order), Load (its key is computed locally), Sample (consumes a
-#    locally-computed shape, cannot hoist).
-_HOISTABLE_KINDS = frozenset({"PrfKeyGen", "Input"})
-_DEFERRABLE_KINDS = frozenset({"Send", "Save", "Output"})
-
-# bound on sends deferred behind one merged segment: merging trades
-# send latency (peers wait for the whole segment) for dispatch cost, so
-# cap how much latency one segment may hoard
-_MAX_DEFERRED = 16
-
-
-def _min_seg() -> int:
-    """Segments below this many ops always run eagerly (not validated,
-    not counted as pinned): a 2-op XLA program saves ~one dispatch but
-    costs a compile during validation, and measured role plans carry
-    dozens of such slivers (~35% of a logreg role's segments holding
-    ~5% of its ops)."""
-    raw = os.environ.get("MOOSE_TPU_WORKER_MIN_SEG", "4")
-    try:
-        return max(1, int(raw))
-    except ValueError as e:
-        from ..errors import ConfigurationError
-
-        raise ConfigurationError(
-            f"MOOSE_TPU_WORKER_MIN_SEG must be an integer, got {raw!r}"
-        ) from e
-
-# dynamic-shape kinds XLA cannot compile; segments containing one run
-# eagerly and are never validated (there is no candidate to validate)
-_DYNAMIC_SHAPE_KINDS = frozenset({"Select"})
+# The segmentation rules (host-step / hoistable / deferrable kind sets,
+# the deferred-send cap, the sliver threshold) live in
+# compilation.analysis.schedule — the worker BUILDS its plan from the
+# same ``build_role_schedule`` the static analyzer checks, so what
+# prancer proves about a plan is what this worker runs.  The aliases
+# keep this module's historical names importable.
 
 
 def worker_jit_enabled() -> bool:
@@ -153,6 +131,7 @@ PLAN_STATS = {
     "cache_hits": 0,
     "validating_evaluations": 0,
     "segments_pinned": 0,
+    "plans_rejected": 0,
 }
 _STATS_LOCK = threading.Lock()
 
@@ -163,6 +142,7 @@ _STAT_METRIC_NAMES = {
     "cache_hits": "moose_tpu_worker_plan_cache_hits_total",
     "validating_evaluations": "moose_tpu_worker_plan_validating_total",
     "segments_pinned": "moose_tpu_worker_segments_pinned_total",
+    "plans_rejected": "moose_tpu_worker_plans_rejected_total",
 }
 _STAT_HELP = {
     "plans_built": "role plans built (compile + boundary analysis)",
@@ -171,6 +151,8 @@ _STAT_HELP = {
     "validating_evaluations": "sessions that ran at least one "
                               "jit-vs-eager segment comparison",
     "segments_pinned": "segments pinned eager after divergence",
+    "plans_rejected": "plans rejected at build time by the MSA5xx "
+                      "schedule analyzer (legacy-scheduler fallback)",
 }
 
 
@@ -346,109 +328,31 @@ class RolePlan:
     on the computation, so it must not hold it strongly."""
 
     def __init__(self, comp, identity: str):
-        from ..execution.interpreter import (
-            _segment_limit,
-            _selfcheck_runs,
-            plan_segments,
-        )
+        from ..execution.interpreter import _selfcheck_runs
 
         self.identity = identity
         self._comp_ref = weakref.ref(comp)
-        order = [
-            n for n in comp.toposort_names()
-            if comp.placement_of(comp.operations[n]).name == identity
-        ]
-        self.order = order
         checks = _selfcheck_runs()
-        limit = _segment_limit()
 
-        # split at HARD host boundaries only, hoisting input-free host
-        # ops before and deferring value-consuming ones after each
-        # merged segment (see the kind sets above); long compute runs
-        # sub-split at the jit segment limit (XLA compile time is
-        # superlinear in program size — same bound as the local
-        # executors)
-        chunks: list[list] = []
-        steps: list = []
-        chunk: list = []
-        pre: list = []
-        post: list = []
-
-        def close():
-            nonlocal chunk, pre, post
-            for n in pre:
-                steps.append(("op", n))
-            if chunk:
-                chunks.append(chunk)
-                steps.append(("seg", len(chunks) - 1))
-            for n in post:
-                steps.append(("op", n))
-            chunk, pre, post = [], [], []
-
-        for n in order:
-            kind = comp.operations[n].kind
-            if kind in _HOISTABLE_KINDS:
-                pre.append(n)
-            elif kind in _DEFERRABLE_KINDS:
-                if not chunk:
-                    close()  # nothing to defer behind: flush hoisted ops
-                    steps.append(("op", n))
-                else:
-                    post.append(n)
-                    if len(post) >= _MAX_DEFERRED:
-                        close()
-            elif kind in _HOST_STEP_KINDS:  # hard: Receive/Load/Sample
-                close()
-                steps.append(("op", n))
-            else:
-                chunk.append(n)
-                if len(chunk) >= limit:
-                    close()
-        close()
-
-        # boundary-dataflow analysis over the partial role graph: values
-        # produced outside any chunk (Receives, host-boundary steps) are
-        # external env inputs
-        _, in_names, _ = plan_segments(
-            order, {}, lambda n: comp.operations[n].inputs, limit,
-            chunks=chunks,
-        )
-        # a segment's outputs are the values ANY later consumer needs —
-        # later segments (their in_names) or host-boundary steps
-        # (Send/Save/Output/... inputs); plan_segments only sees chunk
-        # consumers, so fold the boundary consumers in here
-        needed = set()
-        for ins in in_names:
-            needed.update(ins)
-        for n in order:
-            op = comp.operations[n]
-            if op.kind in _HOST_STEP_KINDS:
-                needed.update(op.inputs)
-        out_names = [
-            sorted(n for n in names if n in needed) for names in chunks
-        ]
-
-        min_seg = _min_seg()
+        # the statically-checkable schedule skeleton — segmentation,
+        # hoisting, deferral, flush grouping — comes from the SAME
+        # function the MSA5xx analyzer reconstructs plans with, so the
+        # plan the analyzer approved is byte-for-byte the plan that runs
+        schedule = build_role_schedule(comp, identity)
+        self.schedule = schedule
         self.segments = [
             _Segment(
-                si, names, in_names[si], out_names[si], self._comp_ref,
-                identity,
-                validatable=(
-                    len(names) >= min_seg
-                    and not any(
-                        comp.operations[n].kind in _DYNAMIC_SHAPE_KINDS
-                        for n in names
-                    )
-                ),
-                checks=checks,
+                seg.index, list(seg.names), list(seg.in_names),
+                list(seg.out_names), self._comp_ref, identity,
+                validatable=seg.validatable, checks=checks,
             )
-            for si, names in enumerate(chunks)
+            for seg in schedule.segments
         ]
-
-        self.steps = steps
-        self.recv_names = [
-            n for n in order if comp.operations[n].kind == "Receive"
+        self.steps = [
+            (kind, payload if kind != "sends" else list(payload))
+            for kind, payload in schedule.steps
         ]
+        self.recv_names = list(schedule.recv_names)
 
     @property
     def pinned_segments(self) -> list:
@@ -480,9 +384,34 @@ class RolePlan:
 _plan_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _cache_lock = threading.Lock()
 
+# MSA5xx verdict per computation: the schedule analysis is pure graph
+# work (no compiles), but on serving traffic the same computation
+# arrives thousands of times — cache the error list weak-keyed like the
+# plans themselves.
+_verdict_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _schedule_errors(comp) -> list:
+    with _cache_lock:
+        cached = _verdict_cache.get(comp)
+    if cached is not None:
+        return cached
+    from ..compilation.analysis.schedule import plan_errors
+
+    errors = plan_errors(comp)
+    with _cache_lock:
+        _verdict_cache[comp] = errors
+    return errors
+
 
 def get_plan(comp, identity: str,
              session_id: Optional[str] = None) -> RolePlan:
+    """Build (or serve warm) the role plan — AFTER the static schedule
+    analyzer approved the computation.  A would-hang plan (MSA5xx
+    error: wait cycle, oversubscribed rendezvous, use-before-arrival)
+    raises :class:`~moose_tpu.errors.PlanRejectedError` at build time;
+    the worker then falls back to the legacy eager scheduler, so the
+    failure mode is a typed diagnostic instead of a runtime hang."""
     with _cache_lock:
         per_comp = _plan_cache.get(comp)
         if per_comp is None:
@@ -491,6 +420,24 @@ def get_plan(comp, identity: str,
     if plan is not None:
         _stat("cache_hits")
         return plan
+    errors = _schedule_errors(comp)
+    if errors:
+        from ..compilation.analysis.diagnostics import format_diagnostics
+
+        _stat("plans_rejected")
+        from .. import flight
+
+        flight.record(
+            "plan_rejected", party=identity, session=session_id,
+            rules=sorted({d.rule for d in errors}),
+            findings=len(errors),
+        )
+        raise PlanRejectedError(
+            f"worker plan for role {identity!r} rejected by the "
+            f"schedule analyzer with {len(errors)} error(s):\n"
+            + format_diagnostics(errors),
+            diagnostics=errors,
+        )
     plan = RolePlan(comp, identity)
     with _cache_lock:
         existing = _plan_cache[comp].get(identity)
@@ -516,12 +463,17 @@ def get_plan(comp, identity: str,
 
 
 class _AsyncSender:
-    """Background send queue: the orchestrator enqueues (value,
-    receiver, rendezvous key) at segment boundaries and moves on; this
-    thread serializes and transmits off the critical path, coalescing
-    CONSECUTIVE same-destination payloads into one ``send_many``
-    envelope when the transport provides it (one rpc instead of N).
-    Errors become the session's root cause via ``on_error``."""
+    """Background send queue: the orchestrator enqueues single sends
+    (host-step sends) or whole deferred flush groups (one per segment
+    close) and moves on; this thread serializes and transmits off the
+    critical path.  Coalescing is DETERMINISTIC and plan-driven: within
+    one flush group, payloads bucket per receiver (first-appearance
+    order, payload order preserved) and each >=2-payload bucket becomes
+    exactly one ``send_many`` envelope — never across groups, never
+    timing-dependent — so the static cost model predicts envelope
+    counts and wire bytes exactly and chaos fault schedules (keyed on
+    stable rendezvous keys) replay identically.  Errors become the
+    session's root cause via ``on_error``."""
 
     def __init__(self, networking, session_id: str, on_error,
                  progress=None, identity: str = ""):
@@ -553,65 +505,79 @@ class _AsyncSender:
             self._loop()
 
     def enqueue(self, value, receiver: str, rendezvous_key: str) -> None:
+        """One single-payload transmission unit (a host-step Send with
+        nothing to defer behind): never coalesced."""
         with self._cv:
             if self._error is not None:
                 return  # session already failing; drop silently
-            self._items.append((value, receiver, rendezvous_key))
+            self._items.append(
+                (receiver, [(rendezvous_key, value)])
+            )
             self._pending += 1
             self._cv.notify()
 
-    def _take_batch(self) -> Optional[list]:
+    def enqueue_group(self, sends: list) -> None:
+        """One deferred flush group: ``[(value, receiver, key), ...]``
+        buckets per receiver (first-appearance order; per-receiver
+        payload order preserved) and each bucket transmits as ONE unit
+        — a ``send_many`` envelope when it carries >=2 payloads.
+        Payloads to different receivers commute (rendezvous-keyed), so
+        the bucketing never reorders anything a peer can observe."""
+        buckets: dict = {}
+        order: list = []
+        for value, receiver, key in sends:
+            if receiver not in buckets:
+                buckets[receiver] = []
+                order.append(receiver)
+            buckets[receiver].append((key, value))
+        with self._cv:
+            if self._error is not None:
+                return
+            for receiver in order:
+                self._items.append((receiver, buckets[receiver]))
+                self._pending += len(buckets[receiver])
+            self._cv.notify()
+
+    def _take_unit(self) -> Optional[tuple]:
         with self._cv:
             while not self._items and not self._closed:
                 self._cv.wait(0.2)
             if not self._items:
                 return None
-            batch = list(self._items)
-            self._items.clear()
-            return batch
+            return self._items.popleft()
 
     def _loop(self) -> None:
         while True:
-            batch = self._take_batch()
-            if batch is None:
+            unit = self._take_unit()
+            if unit is None:
                 return
-            i = 0
-            while i < len(batch):
-                _, receiver, _ = batch[i]
-                j = i
-                while j < len(batch) and batch[j][1] == receiver:
-                    j += 1
-                group = batch[i:j]
-                try:
+            receiver, payloads = unit
+            try:
+                if self._error is None:
+                    self._transmit(receiver, payloads)
+            except BaseException as e:  # noqa: BLE001 — root cause
+                with self._cv:
                     if self._error is None:
-                        self._transmit(receiver, group)
-                except BaseException as e:  # noqa: BLE001 — root cause
-                    with self._cv:
-                        if self._error is None:
-                            self._error = e
-                    self._on_error(e)
-                finally:
-                    with self._cv:
-                        self._pending -= len(group)
-                        self._cv.notify_all()
-                i = j
+                        self._error = e
+                self._on_error(e)
+            finally:
+                with self._cv:
+                    self._pending -= len(payloads)
+                    self._cv.notify_all()
 
-    def _transmit(self, receiver: str, group: list) -> None:
+    def _transmit(self, receiver: str, payloads: list) -> None:
         from .. import flight
 
         send_many = getattr(self._net, "send_many", None)
-        if len(group) > 1 and send_many is not None:
-            send_many(
-                [(key, value) for value, _, key in group], receiver,
-                self._session_id,
-            )
+        if len(payloads) > 1 and send_many is not None:
+            send_many(payloads, receiver, self._session_id)
         else:
-            for value, _, key in group:
+            for key, value in payloads:
                 self._net.send(value, receiver, key, self._session_id)
         flight.record(
             "send", party=self._identity or None,
             session=self._session_id, receiver=receiver,
-            payloads=len(group), coalesced=len(group) > 1,
+            payloads=len(payloads), coalesced=len(payloads) > 1,
         )
         if self._progress is not None:
             self._progress.bump()
@@ -873,8 +839,27 @@ def execute_role_planned(
                     validated |= did_validate
                     progress.bump()
                     continue
+                if kind == "sends":
+                    # one deferred flush group: the sender buckets it
+                    # per receiver and coalesces deterministically (the
+                    # static cost model walks the identical grouping)
+                    from ..values import HostUnit
+
+                    group = []
+                    for n in payload:
+                        op = comp.operations[n]
+                        group.append((
+                            env[op.inputs[0]],
+                            op.attributes["receiver"],
+                            op.attributes["rendezvous_key"],
+                        ))
+                        env[n] = HostUnit(identity)
+                    sender.enqueue_group(group)
+                    continue
                 op = comp.operations[payload]
                 if op.kind == "Send":
+                    # not reachable from build_role_schedule (sends ride
+                    # in flush groups), kept for hand-built plans
                     sender.enqueue(
                         env[op.inputs[0]],
                         op.attributes["receiver"],

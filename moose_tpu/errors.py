@@ -41,6 +41,14 @@ class MalformedComputationError(CompilationError):
         self.diagnostics = tuple(diagnostics)
 
 
+class PlanRejectedError(MalformedComputationError):
+    """The static schedule analyzer (MSA5xx) proved the compiled worker
+    plan would hang — raised by ``worker_plan.get_plan`` at BUILD time
+    so the worker demotes to the legacy eager scheduler instead of
+    blocking at runtime.  Deterministic (a property of the computation),
+    hence never retryable.  Carries ``diagnostics`` like its parent."""
+
+
 class MissingArgumentError(MooseError, KeyError):
     """An Input op had no bound argument at evaluation time."""
 

@@ -149,16 +149,13 @@ class LocalMooseRuntime:
                 return
             info["plan_mode"] = mode
         # the typed plan surface: these three keys are always present
-        # (plan_mode is guaranteed by the branch above)
+        # (plan_mode is guaranteed by the branch above).  last_timings
+        # carries timings ONLY — the deprecated plan_mode/pinned_ops
+        # aliases that rode there for one release are gone;
+        # runtime.last_plan is the single plan surface.
         info["pinned_ops"] = list(info.get("pinned_ops", ()))
         info.setdefault("layout", None)
         self.last_plan = info
-        # DEPRECATED (remove next release; see DEVELOP.md
-        # "Observability"): plan_mode/pinned_ops are NOT timings, but
-        # rode in last_timings before runtime.last_plan existed — kept
-        # one release for callers that still read them there
-        self.last_timings["plan_mode"] = info["plan_mode"]
-        self.last_timings["pinned_ops"] = list(info["pinned_ops"])
 
     def _evaluate_computation(
         self,

@@ -142,6 +142,14 @@ class ModelRegistry:
         with telemetry.span("register_model", model=name) as root:
             comp = self._resolve(model, fixedpoint_dtype)
             self._check_single_output(comp)
+            # strict lint at the door: a model whose graph fails the
+            # static analyzer is a typed CompilationError HERE (blitzen
+            # answers 4xx at registration) — never a worker hang or a
+            # share leak discovered at serve time
+            from ..compilation.analysis import lint_check
+
+            with telemetry.span("lint", model=name):
+                lint_check(comp)
             input_name = input_name or self._input_name(comp)
             if not buckets:
                 buckets = power_of_two_buckets(self.config.max_batch)
@@ -157,6 +165,13 @@ class ModelRegistry:
                 warmup_report[bucket] = self._warm_bucket(
                     comp, input_name, bucket, row_shape, max_warmup_evals
                 )
+            # the CHOSEN plan: if warmup routed through the lowering
+            # pipeline, the lowered/networked graph now sits in the
+            # runtime's compiled cache — run the full strict lint
+            # (including the MSA5xx schedule rules, which only bite on
+            # networked graphs) over it before committing the model
+            with telemetry.span("lint_plan", model=name):
+                self._lint_resolved_plans(comp)
             root.attrs["buckets"] = list(buckets)
             root.attrs["warmup_evals"] = sum(
                 r["evals"] for r in warmup_report.values()
@@ -196,6 +211,32 @@ class ModelRegistry:
         }
 
     # -- internals ---------------------------------------------------------
+
+    def _lint_resolved_plans(self, comp) -> None:
+        """Strict-lint every lowered graph the runtime compiled for
+        ``comp`` during warmup (the plans serving traffic will actually
+        execute).  The MSA5xx schedule analyzer proves the worker plan
+        deadlock-free; errors raise the same typed
+        ``MalformedComputationError`` the logical-graph lint does."""
+        from ..compilation.analysis import lint_check
+        from ..computation import Computation
+
+        # LocalMooseRuntime caches lowered graphs as `_compiled_cache`;
+        # the grpc client runtime as `_compile_cache` with
+        # (Computation, bytes) values — cover both so the plan gate
+        # never silently skips a runtime flavor
+        compiled_cache = getattr(
+            self.runtime, "_compiled_cache", None
+        ) or getattr(self.runtime, "_compile_cache", None)
+        if compiled_cache is None:
+            return
+        per_comp = compiled_cache.get(comp) or {}
+        seen = set()
+        for entry in per_comp.values():
+            lowered = entry[0] if isinstance(entry, tuple) else entry
+            if isinstance(lowered, Computation) and lowered not in seen:
+                seen.add(lowered)
+                lint_check(lowered)
 
     def _resolve(self, model, fixedpoint_dtype):
         from ..computation import Computation

@@ -20,7 +20,15 @@ Asserts:
    **non-empty Prometheus text** carrying worker-plan and networking
    counters; and a chaos-killed session's report attaches the killed
    party's **flight-recorder events** (plus retry/chaos counters on
-   /metrics).
+   /metrics);
+5. (ISSUE 7 static analysis) **predicted-vs-measured**: the static cost
+   model's per-party tx/rx byte and ``send_many`` envelope/payload
+   predictions for one warm session equal the metrics-registry counter
+   deltas EXACTLY — the analyzer can never silently drift from the
+   runtime; and a **deliberately deadlocking segmented plan** is
+   rejected at ``worker_plan.get_plan`` time with an MSA5xx diagnostic
+   (flight ``plan_rejected`` event, legacy-eager fallback, typed
+   failure in seconds instead of a hang).
 
 Prints one JSON summary line (the CI log artifact).
 
@@ -233,6 +241,183 @@ def run_chaos_kill_flight(traced, x) -> dict:
             srv.stop()
 
 
+def _wire_snapshot() -> dict:
+    from moose_tpu import metrics
+
+    v = metrics.REGISTRY.value
+    return {
+        "tx_bytes": v("moose_tpu_net_tx_bytes_total", transport="grpc"),
+        "rx_bytes": v("moose_tpu_net_rx_bytes_total", transport="grpc"),
+        "sends": v("moose_tpu_net_sends_total", transport="grpc"),
+        "send_many_envelopes": v(
+            "moose_tpu_net_send_many_total", transport="grpc"
+        ),
+        "send_many_payloads": v(
+            "moose_tpu_net_send_many_payloads_total", transport="grpc"
+        ),
+        "receives": v(
+            "moose_tpu_net_receives_total", transport="grpc"
+        ),
+    }
+
+
+def check_predicted_vs_measured(runtime, traced, x) -> dict:
+    """ISSUE 7 acceptance: run ONE warm session and require the static
+    cost model's predictions (per-party, summed onto the registry's
+    per-transport counters) to equal the measured deltas EXACTLY —
+    bytes, single sends, coalesced envelopes, coalesced payloads,
+    receives.  Any drift between the analyzer and the runtime wire
+    path fails CI here."""
+    from moose_tpu.compilation.analysis import cost_report
+
+    before = _wire_snapshot()
+    runtime.run_computation(traced, {"x": x}, timeout=300.0)
+    measured = {
+        k: int(after - before[k])
+        for k, after in _wire_snapshot().items()
+    }
+    # the computation the workers actually ran: the client's compiled
+    # cache (lowering bakes nonces, so predicting from a recompile
+    # would still match — keys are deterministic — but the cached
+    # object is the ground truth)
+    per_specs = runtime._compile_cache[traced]
+    compiled, _comp_bytes = next(iter(per_specs.values()))
+    session_id = runtime.last_session_report["attempts"][-1]["session_id"]
+    report = cost_report(compiled, session_id=session_id,
+                         transport="grpc")
+    assert report["resolved"], (
+        "cost model left sends unresolved: "
+        f"{ {p: s['unresolved_sends'] for p, s in report['per_party'].items()} }"
+    )
+    t = report["totals"]
+    predicted = {
+        "tx_bytes": t["tx_bytes"],
+        "rx_bytes": t["rx_bytes"],
+        "sends": t["sends"],
+        "send_many_envelopes": t["send_many_envelopes"],
+        "send_many_payloads": t["send_many_payloads"],
+        "receives": t["receives"],
+    }
+    assert predicted == measured, (
+        f"static cost model drifted from the runtime:\n"
+        f"predicted {predicted}\nmeasured  {measured}"
+    )
+    return {
+        "predicted": predicted,
+        "measured": measured,
+        "per_party": {
+            p: {
+                k: s[k] for k in (
+                    "tx_bytes", "rx_bytes", "sends",
+                    "send_many_envelopes", "send_many_payloads",
+                    "receives",
+                )
+            }
+            for p, s in report["per_party"].items()
+        },
+        "exact_match": True,
+    }
+
+
+def build_deadlock_comp():
+    """A deliberately would-hang computation the schedule analyzer must
+    reject at plan-build time: rendezvous key ``dup-k`` is consumed by
+    TWO Receives on alice but sent once — single-delivery cell-store
+    semantics can serve only the first wait, so the sequential plan
+    (and the legacy scheduler) would sit in a blocked receive until the
+    timeout.  Toposort accepts the graph (no dataflow cycle), so only
+    the MSA5xx plan-level analysis catches it before execution."""
+    from moose_tpu.computation import (
+        Computation,
+        HostFloat64TensorTy,
+        HostPlacement,
+        Operation,
+        Signature,
+        UnitTy,
+    )
+
+    f64 = HostFloat64TensorTy
+    comp = Computation()
+    for name in ("alice", "bob", "carole"):
+        comp.add_placement(HostPlacement(name))
+    comp.add_operation(Operation(
+        "c_b", "Constant", [], "bob", Signature((), f64),
+        {"value": np.zeros((2,))},
+    ))
+    comp.add_operation(Operation(
+        "s_b", "Send", ["c_b"], "bob", Signature((f64,), UnitTy),
+        {"rendezvous_key": "dup-k", "receiver": "alice"},
+    ))
+    for i in (1, 2):
+        comp.add_operation(Operation(
+            f"r_a{i}", "Receive", [], "alice", Signature((), f64),
+            {"rendezvous_key": "dup-k", "sender": "bob"},
+        ))
+    comp.add_operation(Operation(
+        "out", "Output", ["r_a2"], "alice", Signature((f64,), f64),
+    ))
+    return comp
+
+
+def check_deadlock_plan_rejected() -> dict:
+    """ISSUE 7 acceptance: the deadlocking plan is rejected at
+    ``get_plan`` time with an MSA5xx diagnostic and a flight
+    ``plan_rejected`` event, and executing the role anyway (worker jit
+    on) demotes to the legacy eager scheduler whose failure mode is a
+    TYPED receive timeout within seconds — never a hang."""
+    import time
+
+    from moose_tpu import flight
+    from moose_tpu.distributed import worker_plan
+    from moose_tpu.distributed.networking import (
+        LocalNetworking,
+        ProgressClock,
+    )
+    from moose_tpu.distributed.worker import execute_role
+    from moose_tpu.errors import PlanRejectedError, ReceiveTimeoutError
+
+    comp = build_deadlock_comp()
+    rejected = False
+    try:
+        worker_plan.get_plan(comp, "alice", session_id="smoke-deadlock")
+    except PlanRejectedError as e:
+        rejected = True
+        rules = {d.rule for d in e.diagnostics}
+        assert any(r.startswith("MSA5") for r in rules), rules
+        assert "MSA501" in str(e), str(e)
+    assert rejected, "deadlocking plan was NOT rejected at build time"
+    events = flight.get_recorder().events(session="smoke-deadlock")
+    assert any(e["kind"] == "plan_rejected" for e in events), events
+
+    # run the role end-to-end with the fast path ON: the rejection must
+    # demote to the legacy scheduler and surface a typed timeout fast
+    stats_before = worker_plan.plan_stats()
+    net = LocalNetworking()
+    t0 = time.monotonic()
+    typed = False
+    try:
+        execute_role(
+            comp, "alice", {}, {}, net, "smoke-deadlock-2",
+            timeout=2.0, progress=ProgressClock(),
+        )
+    except ReceiveTimeoutError:
+        typed = True
+    elapsed = time.monotonic() - t0
+    assert typed, "expected a typed ReceiveTimeoutError from the " \
+                  "legacy fallback"
+    assert elapsed < 30.0, f"fallback took {elapsed:.1f}s — a hang"
+    stats = worker_plan.plan_stats()
+    assert stats["plans_rejected"] >= (
+        stats_before["plans_rejected"] + 1
+    ), (stats_before, stats)
+    return {
+        "rejected_at_build_time": True,
+        "flight_plan_rejected": True,
+        "fallback_elapsed_s": round(elapsed, 2),
+        "plans_rejected_total": stats["plans_rejected"],
+    }
+
+
 def build_logreg():
     from sklearn.linear_model import LogisticRegression
 
@@ -341,9 +526,17 @@ def main() -> int:
 
         # Prometheus scrape off a worker's metrics port
         scrape = check_metrics_scrape(servers["alice"])
+
+        # --- ISSUE 7 static-analysis gate -------------------------------
+        # predicted-vs-measured: one more warm session, counter deltas
+        # must equal the static cost model exactly
+        cost_gate = check_predicted_vs_measured(runtime, traced, x)
     finally:
         for srv in servers.values():
             srv.stop()
+
+    # deadlocking-plan rejection gate (standalone: in-process worker)
+    deadlock_gate = check_deadlock_plan_rejected()
 
     # chaos-kill postmortem: flight events of the killed party reach
     # last_session_report["flight"] (fresh cluster; the clean one above
@@ -360,6 +553,8 @@ def main() -> int:
         "stitched_trace": stitched,
         "metrics_scrape": scrape,
         "chaos_flight": flight_summary,
+        "cost_predicted_vs_measured": cost_gate,
+        "deadlock_plan_rejected": deadlock_gate,
     }
     print(json.dumps(summary), flush=True)
     return 0

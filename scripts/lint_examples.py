@@ -6,18 +6,28 @@ Each target computation is traced, written to a temp ``.moose`` file,
 and linted through the prancer CLI — the same path a user takes with a
 serialized computation.  The tutorial dot product (constants only, so no
 arg specs needed) is additionally run through the full compile pipeline
-and linted post-networking, exercising the MSA2xx communication rules on
-a real Send/Receive graph.
+and linted post-networking, exercising the MSA2xx communication rules
+AND the MSA5xx/MSA6xx plan rules on a real Send/Receive graph.
+
+When the reference checkout is present (``/root/reference``, or
+``MOOSE_REFERENCE_DIR``), every ``.moose`` artifact the reference ships
+is linted too — the first machine-checked tie to the ROADMAP's interop
+anchor: graphs the reference runtime executes must be clean under our
+analyzer as well.
 
     python scripts/lint_examples.py
 """
 
+import glob
+import os
 import pathlib
 import sys
 import tempfile
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(ROOT))
+
+REFERENCE_DIR = os.environ.get("MOOSE_REFERENCE_DIR", "/root/reference")
 
 # (label, module, attribute) — module-level @pm.computation objects
 TARGETS = [
@@ -67,6 +77,7 @@ def main() -> int:
     ))
 
     failures = 0
+    linted = 0
     with tempfile.TemporaryDirectory() as tmp:
         for i, (label, comp) in enumerate(graphs):
             path = pathlib.Path(tmp) / f"comp_{i}.moose"
@@ -75,10 +86,33 @@ def main() -> int:
             status = "clean" if rc == 0 else "FAILED"
             print(f"[{status}] {label} ({len(comp.operations)} ops)")
             failures += rc != 0
+            linted += 1
+
+    # the reference's own shipped artifacts (ROADMAP item 5's interop
+    # anchor): every .moose graph the reference executes must also be
+    # clean under prancer — including the MSA5xx schedule rules on the
+    # pre-networked *-networked/-compiled artifacts
+    artifacts = sorted(
+        glob.glob(f"{REFERENCE_DIR}/**/*.moose", recursive=True)
+    )
+    if artifacts:
+        for path in artifacts:
+            rc = prancer([path])
+            status = "clean" if rc == 0 else "FAILED"
+            rel = os.path.relpath(path, REFERENCE_DIR)
+            print(f"[{status}] reference artifact {rel}")
+            failures += rc != 0
+            linted += 1
+    else:
+        print(
+            f"# reference artifacts not present under {REFERENCE_DIR}; "
+            "skipping (CI runs them when the checkout is mounted)"
+        )
+
     if failures:
         print(f"{failures} computation(s) failed lint", file=sys.stderr)
         return 1
-    print(f"all {len(graphs)} computations lint clean")
+    print(f"all {linted} computations lint clean")
     return 0
 
 

@@ -450,9 +450,12 @@ def test_ndarray_attributes_are_structurally_compared():
 
 def test_every_rule_is_catalogued():
     assert set(ANALYSES) == {
-        "secrecy", "communication", "signatures", "hygiene"
+        "secrecy", "communication", "signatures", "hygiene",
+        "schedule", "cost",
     }
-    assert {r[:4] for r in RULES} == {"MSA1", "MSA2", "MSA3", "MSA4"}
+    assert {r[:4] for r in RULES} == {
+        "MSA1", "MSA2", "MSA3", "MSA4", "MSA5", "MSA6"
+    }
 
 
 def test_ignore_suppresses_rule_and_family():
@@ -668,3 +671,422 @@ def test_prancer_cli_survives_corrupt_file(tmp_path, capsys):
     assert main([str(corrupt), "--format", "json"]) == 1
     records = json.loads(capsys.readouterr().out)
     assert records[0]["rule"] == "prancer"
+
+
+# ---------------------------------------------------------------------------
+# MSA5xx execution-plan schedule
+# ---------------------------------------------------------------------------
+
+
+def _networked_pair_graph():
+    """alice computes, sends to bob; bob receives and outputs — the
+    minimal clean two-role networked graph."""
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation(
+        "c", "Constant", [], "alice", Signature((), ring),
+        {"value": np.zeros((2, 2))},
+    ))
+    comp.add_operation(Operation(
+        "m", "Mul", ["c", "c"], "alice", Signature((ring, ring), ring),
+    ))
+    comp.add_operation(Operation(
+        "s", "Send", ["m"], "alice", Signature((ring,), UnitTy),
+        {"rendezvous_key": "k-0", "receiver": "bob"},
+    ))
+    comp.add_operation(Operation(
+        "r", "Receive", [], "bob", Signature((), ring),
+        {"rendezvous_key": "k-0", "sender": "alice"},
+    ))
+    comp.add_operation(Operation(
+        "out", "Output", ["r"], "bob", Signature((ring,), ring),
+    ))
+    return comp
+
+
+def test_schedule_noop_on_prenetworking_and_single_role():
+    # pre-networking (composite placements): documented no-op
+    assert analyze(_leak_graph(), analyses=["schedule"]) == []
+    assert analyze(_leak_graph(), analyses=["cost"]) == []
+    # single-role host graph without Send/Receive: no plan to check
+    comp = Computation()
+    _hosts(comp, "alice")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation("out", "Output", ["x"], "alice", SIG1))
+    assert analyze(comp, analyses=["schedule"]) == []
+    assert analyze(comp, analyses=["cost"]) == []
+
+
+def test_clean_networked_graph_has_no_schedule_errors():
+    diags = analyze(_networked_pair_graph(), analyses=["schedule"])
+    assert not [d for d in diags if d.severity >= Severity.ERROR], diags
+
+
+def test_oversubscribed_rendezvous_fires_msa501():
+    """Two Receives on one key: single-delivery cell semantics can only
+    serve the first wait — the op-level MSA2xx sees a duplicate key,
+    the plan-level analysis proves the HANG."""
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = _networked_pair_graph()
+    comp.add_operation(Operation(
+        "r2", "Receive", [], "bob", Signature((), ring),
+        {"rendezvous_key": "k-0", "sender": "alice"},
+    ))
+    diags = analyze(comp, analyses=["schedule"])
+    msa501 = [d for d in diags if d.rule == "MSA501"]
+    assert msa501, diags
+    assert any("oversubscribed" in d.message for d in msa501)
+    assert all(d.severity is Severity.ERROR for d in msa501)
+
+
+def test_wait_cycle_between_sequential_schedules_fires_msa501():
+    """The strict generalization of MSA204: two roles whose sends are
+    dataflow-INDEPENDENT of their receives (the parallel eager
+    scheduler would complete) but whose SEQUENTIAL schedules order the
+    receive first on both sides — only the plan-level wait graph sees
+    the cycle.  Built with an explicit order, since toposort's shared
+    linearization makes the reconstruction deadlock-free by
+    construction (which is exactly the theorem the analyzer encodes)."""
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_schedules,
+        build_role_schedule,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    for role, send_key, recv_key in (
+        ("alice", "k-ab", "k-ba"), ("bob", "k-ba", "k-ab"),
+    ):
+        comp.add_operation(Operation(
+            f"c_{role}", "Constant", [], role, Signature((), ring),
+            {"value": np.zeros((2,))},
+        ))
+        comp.add_operation(Operation(
+            f"r_{role}", "Receive", [], role, Signature((), ring),
+            {"rendezvous_key": recv_key, "sender": "x"},
+        ))
+        comp.add_operation(Operation(
+            f"s_{role}", "Send", [f"c_{role}"], role,
+            Signature((ring,), UnitTy),
+            {"rendezvous_key": send_key, "receiver": "x"},
+        ))
+    # receive BEFORE the (independent) send on both roles
+    schedules = {
+        role: build_role_schedule(
+            comp, role,
+            order=[f"c_{role}", f"r_{role}", f"s_{role}"],
+        )
+        for role in ("alice", "bob")
+    }
+    diags = analyze_schedules(comp, schedules)
+    msa501 = [d for d in diags if d.rule == "MSA501"]
+    assert msa501, diags
+    assert any("blocking chain" in d.message for d in msa501)
+    # ... while the op-level rendezvous analysis sees nothing wrong
+    op_level = analyze(comp, analyses=["communication"])
+    assert "MSA204" not in rules_of(op_level)
+
+
+def test_deferred_send_overflow_fires_msa502():
+    """>MAX_DEFERRED sends queued behind one merged segment force an
+    early split — previously silent, now a warning naming the count."""
+    from moose_tpu.compilation.analysis.schedule import MAX_DEFERRED
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation(
+        "c", "Constant", [], "alice", Signature((), ring),
+        {"value": np.zeros((2,))},
+    ))
+    prev = "c"
+    for i in range(MAX_DEFERRED + 4):
+        comp.add_operation(Operation(
+            f"m{i}", "Mul", [prev, prev], "alice",
+            Signature((ring, ring), ring),
+        ))
+        comp.add_operation(Operation(
+            f"s{i}", "Send", [f"m{i}"], "alice",
+            Signature((ring,), UnitTy),
+            {"rendezvous_key": f"k-{i}", "receiver": "bob"},
+        ))
+        comp.add_operation(Operation(
+            f"r{i}", "Receive", [], "bob", Signature((), ring),
+            {"rendezvous_key": f"k-{i}", "sender": "alice"},
+        ))
+        prev = f"m{i}"
+    order = (
+        ["c"]
+        + [f"m{i}" for i in range(MAX_DEFERRED + 4)]
+        + [f"s{i}" for i in range(MAX_DEFERRED + 4)]
+        + [f"r{i}" for i in range(MAX_DEFERRED + 4)]
+    )
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_schedules,
+        build_role_schedule,
+    )
+
+    schedules = {
+        "alice": build_role_schedule(comp, "alice", order=order),
+        "bob": build_role_schedule(comp, "bob", order=order),
+    }
+    diags = analyze_schedules(comp, schedules)
+    msa502 = [d for d in diags if d.rule == "MSA502"]
+    assert msa502, diags
+    assert msa502[0].severity is Severity.WARNING
+    assert str(MAX_DEFERRED) in msa502[0].message
+
+
+def test_use_before_arrival_fires_msa503():
+    """A hand-built order that consumes a Receive's value before its
+    wait step: the analyzer must reject what the orchestrator would
+    crash/hang on (the reconstruction from toposort can never produce
+    this — the rule guards future planners and hand-built plans)."""
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_schedules,
+        build_role_schedule,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = _networked_pair_graph()
+    comp.add_operation(Operation(
+        "use", "Mul", ["r", "r"], "bob", Signature((ring, ring), ring),
+    ))
+    bad = build_role_schedule(comp, "bob", order=["use", "r", "out"])
+    alice = build_role_schedule(comp, "alice")
+    diags = analyze_schedules(comp, {"alice": alice, "bob": bad})
+    assert "MSA503" in {d.rule for d in diags}, diags
+    (d,) = [x for x in diags if x.rule == "MSA503"]
+    assert d.severity is Severity.ERROR and d.placement == "bob"
+
+
+def test_jit_eager_straddle_fires_msa504(monkeypatch):
+    """A sliver (below MOOSE_TPU_WORKER_MIN_SEG) segment feeding a
+    jit-candidate segment is an informational host/device boundary
+    note."""
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_schedules,
+        reconstruct_schedules,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = _networked_pair_graph()
+    # bob: tiny 1-op segment (sliver) -> hard boundary (the receive) ->
+    # a >=min_seg segment consuming the sliver's value
+    comp.add_operation(Operation(
+        "pre", "Mul", ["r", "r"], "bob", Signature((ring, ring), ring),
+    ))
+    prev = "pre"
+    comp.add_operation(Operation(
+        "r2", "Receive", [], "bob", Signature((), ring),
+        {"rendezvous_key": "k-1", "sender": "alice"},
+    ))
+    comp.add_operation(Operation(
+        "s2", "Send", ["m"], "alice", Signature((ring,), UnitTy),
+        {"rendezvous_key": "k-1", "receiver": "bob"},
+    ))
+    for i in range(4):
+        comp.add_operation(Operation(
+            f"big{i}", "Mul", [prev, prev], "bob",
+            Signature((ring, ring), ring),
+        ))
+        prev = f"big{i}"
+    monkeypatch.setenv("MOOSE_TPU_WORKER_MIN_SEG", "4")
+    from moose_tpu.compilation.analysis.schedule import (
+        build_role_schedule,
+    )
+
+    # explicit order pinning the receive boundary between the sliver
+    # and the big segment (Kahn may otherwise merge them)
+    schedules = {
+        "alice": build_role_schedule(comp, "alice"),
+        "bob": build_role_schedule(
+            comp, "bob",
+            order=["r", "pre", "r2"]
+            + [f"big{i}" for i in range(4)] + ["out"],
+        ),
+    }
+    diags = analyze_schedules(comp, schedules)
+    msa504 = [d for d in diags if d.rule == "MSA504"]
+    assert msa504, diags
+    assert msa504[0].severity is Severity.INFO
+
+
+# ---------------------------------------------------------------------------
+# MSA6xx cost model
+# ---------------------------------------------------------------------------
+
+
+def test_payload_bytes_match_real_serialization():
+    """The placeholder pricing must equal serialize_value on real
+    values of the same shape/dtype for every wire kind."""
+    import jax.numpy as jnp
+
+    from moose_tpu.compilation.analysis.cost import (
+        ValueSpec,
+        payload_bytes,
+    )
+    from moose_tpu.serde import serialize_value
+    from moose_tpu.values import (
+        HostBitTensor,
+        HostPrfKey,
+        HostRingTensor,
+        HostShape,
+        HostTensor,
+    )
+
+    rng = np.random.default_rng(0)
+    lo = jnp.asarray(rng.integers(0, 2**63, size=(3, 5)).astype(np.uint64))
+    hi = jnp.asarray(rng.integers(0, 2**63, size=(3, 5)).astype(np.uint64))
+    cases = [
+        (
+            HostRingTensor(lo, hi, 128, "a"),
+            ValueSpec("ring", (3, 5), width=128),
+        ),
+        (
+            HostRingTensor(lo, None, 64, "a"),
+            ValueSpec("ring", (3, 5), width=64),
+        ),
+        (
+            HostBitTensor(
+                jnp.asarray(rng.integers(0, 2, size=(7, 3)).astype(
+                    np.uint8
+                )), "a",
+            ),
+            ValueSpec("bit", (7, 3)),
+        ),
+        (
+            HostTensor(
+                jnp.asarray(rng.normal(size=(4,))), "a", pm.float64
+            ),
+            ValueSpec("tensor", (4,), dtype=pm.float64),
+        ),
+        (HostShape((16, 8), "a"), ValueSpec("shape", value=(16, 8))),
+        (
+            HostPrfKey(jnp.asarray(
+                rng.integers(0, 2**32, size=4).astype(np.uint32)
+            ), "a"),
+            ValueSpec("key"),
+        ),
+    ]
+    for value, spec in cases:
+        assert payload_bytes(spec) == len(serialize_value(value)), spec
+
+
+def test_unresolvable_send_payload_fires_msa601():
+    """An Input sent raw (no statically-shaped mask ever unifies it):
+    the model must say so instead of guessing."""
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation(
+        "s", "Send", ["x"], "alice", Signature((F64,), UnitTy),
+        {"rendezvous_key": "k-0", "receiver": "bob"},
+    ))
+    comp.add_operation(Operation(
+        "r", "Receive", [], "bob", SIG0,
+        {"rendezvous_key": "k-0", "sender": "alice"},
+    ))
+    comp.add_operation(Operation("out", "Output", ["r"], "bob", SIG1))
+    diags = analyze(comp, analyses=["cost"])
+    assert "MSA601" in rules_of(diags), diags
+    # ... and pinning the Input shape resolves it
+    from moose_tpu.compilation.analysis import cost_report
+
+    report = cost_report(comp, arg_specs={"x": ((4, 3), np.float64)})
+    assert report["resolved"], report
+    assert report["per_party"]["alice"]["tx_bytes"] > 0
+
+
+def test_cost_report_shapes_flow_through_masking():
+    """The protocol idiom — unknown Input masked by a statically-shaped
+    sample — resolves through elementwise unification."""
+    from moose_tpu.compilation.analysis import cost_report, infer_specs
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing64Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation(
+        "xe", "RingFixedpointEncode", ["x"], "alice",
+        Signature((F64,), ring), {"scaling_exp": 10},
+    ))
+    comp.add_operation(Operation(
+        "shp", "Constant", [], "alice", Signature((), Ty("HostShape")),
+        {"value": (4, 3)},
+    ))
+    comp.add_operation(Operation(
+        "mask", "Fill", ["shp"], "alice",
+        Signature((Ty("HostShape"),), ring), {"value": 0},
+    ))
+    comp.add_operation(Operation(
+        "share", "Sub", ["xe", "mask"], "alice",
+        Signature((ring, ring), ring),
+    ))
+    comp.add_operation(Operation(
+        "s", "Send", ["share"], "alice", Signature((ring,), UnitTy),
+        {"rendezvous_key": "k-0", "receiver": "bob"},
+    ))
+    comp.add_operation(Operation(
+        "r", "Receive", [], "bob", Signature((), ring),
+        {"rendezvous_key": "k-0", "sender": "alice"},
+    ))
+    comp.add_operation(Operation(
+        "out", "Output", ["r"], "bob", Signature((ring,), ring),
+    ))
+    specs = infer_specs(comp)
+    assert specs["share"].kind == "ring"
+    assert specs["share"].shape == (4, 3)
+    # the Receive adopts the matched Send's payload spec
+    assert specs["r"].shape == (4, 3)
+    report = cost_report(comp)
+    assert report["resolved"]
+    # one 4x3 ring64 payload: 96 raw bytes + msgpack envelope
+    alice = report["per_party"]["alice"]
+    assert alice["sends"] == 1 and alice["tx_bytes"] > 96
+    assert report["per_party"]["bob"]["rx_bytes"] == alice["tx_bytes"]
+    assert report["per_party"]["bob"]["receives"] == 1
+
+
+def test_prancer_cli_schedule_and_cost_report(tmp_path, capsys):
+    import json
+
+    from moose_tpu.bin.prancer import main
+    from moose_tpu.textual import to_textual
+
+    path = tmp_path / "pair.moose"
+    path.write_text(to_textual(_networked_pair_graph()))
+    rc = main([
+        str(path), "--schedule", "--cost", "--format", "json",
+        "--analyses", "schedule,cost",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    report = payload["reports"][str(path)]
+    assert report["analyzable"] is True
+    assert set(report["schedule"]) == {"alice", "bob"}
+    assert report["cost"]["resolved"] is True
+    totals = report["cost"]["totals"]
+    assert totals["tx_bytes"] == totals["rx_bytes"] > 0
+    # --role filters the report
+    rc = main([
+        str(path), "--schedule", "--role", "alice", "--format", "json",
+        "--analyses", "schedule",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert set(payload["reports"][str(path)]["schedule"]) == {"alice"}

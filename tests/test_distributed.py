@@ -1080,3 +1080,149 @@ def test_full_predictor_softmax_across_grpc_workers():
     finally:
         for srv in servers.values():
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: static schedule/cost analysis wired into the worker plan
+# ---------------------------------------------------------------------------
+
+
+def _oversubscribed_comp():
+    """Rendezvous key consumed by two Receives but sent once: a
+    would-hang plan that toposorts cleanly (only the MSA5xx plan-level
+    analysis rejects it before execution)."""
+    from moose_tpu.computation import (
+        Computation,
+        HostFloat64TensorTy,
+        HostPlacement,
+        Operation,
+        Signature,
+        UnitTy,
+    )
+
+    f64 = HostFloat64TensorTy
+    comp = Computation()
+    for name in ("alice", "bob"):
+        comp.add_placement(HostPlacement(name))
+    comp.add_operation(Operation(
+        "c", "Constant", [], "bob", Signature((), f64),
+        {"value": np.zeros((2,))},
+    ))
+    comp.add_operation(Operation(
+        "s", "Send", ["c"], "bob", Signature((f64,), UnitTy),
+        {"rendezvous_key": "dup", "receiver": "alice"},
+    ))
+    for i in (1, 2):
+        comp.add_operation(Operation(
+            f"r{i}", "Receive", [], "alice", Signature((), f64),
+            {"rendezvous_key": "dup", "sender": "bob"},
+        ))
+    comp.add_operation(Operation(
+        "out", "Output", ["r2"], "alice", Signature((f64,), f64),
+    ))
+    return comp
+
+
+def test_would_deadlock_plan_rejected_at_build_time(monkeypatch):
+    """get_plan must reject the plan BEFORE anything executes: typed
+    PlanRejectedError carrying MSA501 diagnostics, a plans_rejected
+    stat, and a flight plan_rejected event."""
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    from moose_tpu import flight
+    from moose_tpu.distributed import worker_plan
+    from moose_tpu.errors import PlanRejectedError
+
+    comp = _oversubscribed_comp()
+    before = worker_plan.plan_stats()
+    with pytest.raises(PlanRejectedError) as exc_info:
+        worker_plan.get_plan(comp, "alice", session_id="rej-1")
+    err = exc_info.value
+    assert any(d.rule == "MSA501" for d in err.diagnostics), (
+        err.diagnostics
+    )
+    assert "MSA501" in str(err)
+    delta = _stats_delta(before, worker_plan.plan_stats())
+    assert delta["plans_rejected"] == 1
+    assert delta["plans_built"] == 0
+    events = flight.get_recorder().events(session="rej-1")
+    assert any(e["kind"] == "plan_rejected" for e in events), events
+    # rejection is not retryable: resubmitting the same computation
+    # deterministically re-fails
+    from moose_tpu.errors import is_retryable
+
+    assert not is_retryable(err)
+
+
+def test_rejected_plan_falls_back_to_legacy_scheduler(monkeypatch):
+    """execute_role with the fast path on must demote to the legacy
+    eager scheduler on rejection (typed timeout in seconds — never a
+    hang, and never a crash on the rejection itself)."""
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    import time
+
+    from moose_tpu.distributed.networking import (
+        LocalNetworking,
+        ProgressClock,
+    )
+    from moose_tpu.errors import ReceiveTimeoutError
+
+    comp = _oversubscribed_comp()
+    net = LocalNetworking()
+    t0 = time.monotonic()
+    with pytest.raises(ReceiveTimeoutError):
+        execute_role(
+            comp, "alice", {}, {}, net, "rej-2", timeout=1.0,
+            progress=ProgressClock(),
+        )
+    assert time.monotonic() - t0 < 20.0
+
+
+def test_cost_model_matches_measured_counters_exactly(monkeypatch):
+    """The ISSUE 7 tentpole contract at test granularity: the static
+    cost model's predictions for the secure-dot session equal the
+    metrics-registry deltas EXACTLY on the local transport — bytes,
+    singles, coalesced envelopes/payloads, receives."""
+    monkeypatch.setenv("MOOSE_TPU_WORKER_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_JIT_SELFCHECK", "1")
+    from moose_tpu import metrics
+    from moose_tpu.compilation.analysis import cost_report
+
+    rng = np.random.default_rng(4)
+    args = {"x": rng.normal(size=(4, 3)), "w": rng.normal(size=(3, 2))}
+    compiled = compile_computation(
+        tracer.trace(_secure_dot_comp()), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+
+    names = {
+        "tx_bytes": "moose_tpu_net_tx_bytes_total",
+        "rx_bytes": "moose_tpu_net_rx_bytes_total",
+        "sends": "moose_tpu_net_sends_total",
+        "send_many_envelopes": "moose_tpu_net_send_many_total",
+        "send_many_payloads": "moose_tpu_net_send_many_payloads_total",
+        "receives": "moose_tpu_net_receives_total",
+    }
+
+    def snap():
+        return {
+            k: metrics.REGISTRY.value(v, transport="local")
+            for k, v in names.items()
+        }
+
+    net = LocalNetworking()
+    before = snap()
+    _run_workers(compiled, ["alice", "bob", "carole"], args,
+                 lambda i: net)
+    measured = {k: int(v - before[k]) for k, v in snap().items()}
+    report = cost_report(compiled, session_id="sess-1",
+                         transport="local")
+    assert report["resolved"], report
+    predicted = {k: int(report["totals"][k]) for k in names}
+    assert predicted == measured
+    # per-party numbers are self-consistent with the totals
+    for key in names:
+        assert sum(
+            report["per_party"][p][key] for p in report["per_party"]
+        ) == predicted[key]

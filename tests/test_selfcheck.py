@@ -450,9 +450,9 @@ def test_small_graph_promotes_to_segmented_via_runtime(monkeypatch):
     for _ in range(3):  # 2 validating runs (K=2 default) + 1 jitted
         (got,) = rt.evaluate_computation(comp, arguments=args).values()
         np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
-    assert rt.last_timings["plan_mode"] == "segmented"
+    assert rt.last_plan["plan_mode"] == "segmented"
     assert rt.last_plan.get("plan_state") == "jit"
-    assert rt.last_timings["pinned_ops"] == []
+    assert rt.last_plan["pinned_ops"] == []
 
 
 @pytest.mark.slow
@@ -502,9 +502,9 @@ def test_big_lowered_graph_promotes_to_segmented_on_cpu(monkeypatch):
     for _ in range(3):  # 2 validating runs (K=2 default) + 1 jitted
         (got,) = rt.evaluate_computation(lowered, arguments=args).values()
         np.testing.assert_allclose(np.asarray(got), want, atol=5e-3)
-    assert rt.last_timings["plan_mode"] == "segmented"
+    assert rt.last_plan["plan_mode"] == "segmented"
     assert rt.last_plan.get("plan_state") == "jit"
-    assert rt.last_timings["pinned_ops"] == []
+    assert rt.last_plan["pinned_ops"] == []
 
 
 def test_per_op_limit_skips_rung_to_eager(monkeypatch):

@@ -417,7 +417,7 @@ def test_stacked_ladder_exhaustion_reroutes_to_per_host(monkeypatch):
 
     (got2,) = rt.evaluate_computation(comp, arguments=args).values()
     assert rt.last_plan.get("layout") == "per-host"  # rerouted
-    assert rt.last_timings.get("plan_mode") is not None
+    assert rt.last_plan.get("plan_mode") is not None
     np.testing.assert_array_equal(np.asarray(got1), np.asarray(got2))
 
 
@@ -460,9 +460,9 @@ def test_stacked_userpath_per_op_plan_mode_via_runtime(monkeypatch):
         np.testing.assert_allclose(np.asarray(got), want, atol=5e-3)
         if rt.last_plan.get("plan_state") == "per-op":
             break
-    assert rt.last_timings["plan_mode"] == "per-op"
+    assert rt.last_plan["plan_mode"] == "per-op"
     traced = rt._trace_cache[comp]
-    pinned = rt.last_timings["pinned_ops"]
+    pinned = rt.last_plan["pinned_ops"]
     assert [traced.operations[n].kind for n in pinned] == ["Mul"]
     assert rt.last_plan.get("layout") == "stacked"
 

@@ -57,13 +57,13 @@ def test_runtime_surfaces_plan_mode():
 
     runtime = LocalMooseRuntime(["alice"], use_jit=False)
     runtime.evaluate_computation(comp, arguments={"x": np.ones((4,))})
-    assert runtime.last_timings["plan_mode"] == "eager"
-    assert runtime.last_timings["pinned_ops"] == []
+    assert runtime.last_plan["plan_mode"] == "eager"
+    assert runtime.last_plan["pinned_ops"] == []
     assert runtime.last_plan["layout"] == "per-host"
 
     jit_rt = LocalMooseRuntime(["alice"], use_jit=True)
     jit_rt.evaluate_computation(comp, arguments={"x": np.ones((4,))})
-    assert jit_rt.last_timings["plan_mode"] == "whole-graph"
+    assert jit_rt.last_plan["plan_mode"] == "whole-graph"
 
 
 def test_runtime_records_phase_timings():
