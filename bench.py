@@ -110,14 +110,19 @@ def tpu_numerics_check():
 def stacked_userpath_numerics_check():
     """Real-chip numerics gate for the STACKED USER PATH (VERDICT r5
     Weak #5): a small traced logreg graph (cast -> replicated dot ->
-    protocol sigmoid -> reveal) runs through
-    ``LocalMooseRuntime(layout="stacked")`` at fixed(14,23) AND
-    fixed(24,40) — the precision whose fused sigmoid is the known
+    protocol sigmoid -> reveal) runs through the DEFAULT
+    ``LocalMooseRuntime`` (layout "auto" since ISSUE 9 —
+    stacked-where-supported is the default pipeline) at fixed(14,23)
+    AND fixed(24,40) — the precision whose fused sigmoid is the known
     miscompile reproducer — with the validated-jit ladder driven to
     steady state, and the RESOLVED plan's outputs verified against
     numpy.  A ladder regression (wrong promotion, missed pin) then
     surfaces as ``stacked_userpath_numerics_ok=false`` in the bench
-    JSON instead of a 7 inf/s surprise five stages later."""
+    JSON instead of a 7 inf/s surprise five stages later.  Returns the
+    per-precision resolved plans so the record can attest that auto
+    routed stacked / whole-graph / zero pins (the ISSUE 9 acceptance
+    shape) — recorded, not asserted: a TPU demotion must surface as an
+    honest flagged number, not kill the gate."""
     import moose_tpu as pm
     from moose_tpu.runtime import LocalMooseRuntime
 
@@ -129,6 +134,7 @@ def stacked_userpath_numerics_check():
     bob = pm.host_placement("bob")
     carole = pm.host_placement("carole")
     rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+    plans = {}
     for integ, frac in ((14, 23), (24, 40)):
         fx = pm.fixed(integ, frac)
 
@@ -147,9 +153,8 @@ def stacked_userpath_numerics_check():
                 out = pm.cast(y, dtype=pm.float64)
             return out
 
-        rt = LocalMooseRuntime(
-            ["alice", "bob", "carole"], use_jit=True, layout="stacked"
-        )
+        # DEFAULT layout: auto must route this replicated graph stacked
+        rt = LocalMooseRuntime(["alice", "bob", "carole"], use_jit=True)
         arguments = {"xa": x, "wa": w}
         out = next(iter(
             rt.evaluate_computation(logreg, arguments=arguments).values()
@@ -162,12 +167,92 @@ def stacked_userpath_numerics_check():
                     logreg, arguments=arguments
                 ).values()
             ))
+        plans[f"fixed({integ},{frac})"] = {
+            "layout": rt.last_plan.get("layout"),
+            "plan_mode": rt.last_plan.get("plan_mode"),
+            "pinned_ops": len(rt.last_plan.get("pinned_ops") or ()),
+        }
         err = np.abs(np.asarray(out) - want).max()
         assert err < 5e-3, (
             f"stacked user-path numerics: fixed({integ},{frac}) "
             f"err={err} (plan {rt.last_plan})"
         )
-    return True
+    return plans
+
+
+def _pallas_report() -> dict:
+    from moose_tpu.native import ring128_kernels as rk
+
+    return rk.report()
+
+
+def bench_pallas_kernels(iters=5):
+    """Per-kernel A/B microbench (ISSUE 9): each hot stacked primitive
+    timed as one jitted program with the Pallas kernels forced ON vs
+    forced OFF, at the miscompile precision fixed(24,40)/ring128 on a
+    (128, 100) batch.  Returns {primitive: {"pallas_s", "xla_s"}} —
+    honest per-primitive evidence of what the kernels buy (or cost) on
+    the current backend, alongside the whole-path numbers."""
+    from moose_tpu.native import ring128_kernels as rk
+    from moose_tpu.parallel import spmd_math as sm
+
+    import jax.numpy as jnp
+
+    mk = np.arange(4, dtype=np.uint32) + 5
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(128, 100)) * 0.5
+    y = rng.normal(size=(128, 100)) * 0.5
+
+    def fx_mul_fn():
+        def run(master_key, a, b):
+            sess = spmd.SpmdSession(master_key)
+            xs = spmd.fx_encode_share(sess, a, 24, 40, 128)
+            ys = spmd.fx_encode_share(sess, b, 24, 40, 128)
+            return jnp.sum(spmd.fx_mul(sess, xs, ys).tensor.lo)
+        return run
+
+    def msb_fn():
+        def run(master_key, a, b):
+            sess = spmd.SpmdSession(master_key)
+            xs = spmd.fx_encode_share(sess, a, 24, 40, 128)
+            return jnp.sum(sm.msb(sess, xs.tensor).arr)
+        return run
+
+    def sigmoid_fn():
+        def run(master_key, a, b):
+            sess = spmd.SpmdSession(master_key)
+            xs = spmd.fx_encode_share(sess, a, 24, 40, 128)
+            return jnp.sum(sm.fx_sigmoid(sess, xs).tensor.lo)
+        return run
+
+    # fresh verdicts for the A/B: a primitive pinned to fallback by an
+    # earlier stage (transient error) would otherwise measure XLA on
+    # BOTH sides while being reported as pallas
+    rk.reset_state()
+    out = {}
+    for name, build in (
+        ("fx_mul", fx_mul_fn), ("msb", msb_fn), ("fx_sigmoid", sigmoid_fn)
+    ):
+        entry = {}
+        for label, on in (("pallas_s", True), ("xla_s", False)):
+            rk.set_enabled(on)
+            try:
+                fn = jax.jit(build())
+                jax.block_until_ready(fn(mk, x, y))  # compile + warm
+                times = []
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(fn(mk, x, y))
+                    times.append(time.perf_counter() - t0)
+                entry[label] = float(np.median(times))
+            except Exception as e:  # noqa: BLE001 — report as data
+                entry[label] = f"error: {type(e).__name__}: {e}"
+            finally:
+                rk.set_enabled(None)
+        out[name] = entry
+    # which kernels the pallas legs ACTUALLY ran (vs fell back)
+    out["kernel_verdicts"] = _pallas_report()["kernels"]
+    return out
 
 
 def bench_distributed_logreg(batch=128, features=100, iters=4,
@@ -390,8 +475,14 @@ def _bench_predictor(comp, args, check, batch, layout=None, iters=5,
         # disables the auto-lowering route, keeping the logical fused
         # path
         os.environ["MOOSE_TPU_JIT_SEGMENT"] = "0"
+    # layout=None pins per-host explicitly: since layout "auto" became
+    # the runtime default (ISSUE 9) a None here would route replicated
+    # graphs stacked — but this branch's env knobs disable the heavy
+    # gate, which is only safe on the per-host fused path the
+    # established logreg/mlp metrics have always measured
     runtime = LocalMooseRuntime(
-        ["alice", "bob", "carole"], use_jit=True, layout=layout
+        ["alice", "bob", "carole"], use_jit=True,
+        layout=layout or "per-host",
     )
     # the first call compiles; on a cold cache the tunnel makes big
     # segment compiles take tens of minutes — bound it so the bench
@@ -737,9 +828,13 @@ def main():
 
     # stacked USER-PATH numerics gate (VERDICT r5 Weak #5): the traced
     # logreg graph through the validated-jit ladder at both working
-    # precisions, verified on the real backend before any timing
+    # precisions, verified on the real backend before any timing —
+    # through the DEFAULT (auto) layout since ISSUE 9, so it also
+    # attests the stacked-by-default routing and plan shape
+    userpath_plans = None
     try:
-        stacked_numerics_ok = stacked_userpath_numerics_check()
+        userpath_plans = stacked_userpath_numerics_check()
+        stacked_numerics_ok = True
     except Exception as e:  # noqa: BLE001 — recorded loudly, never
         # suppresses the headline record
         print(
@@ -802,6 +897,14 @@ def main():
         "n_samples": len(t_rbg),
         "tpu_numerics_ok": tpu_numerics_ok,
         "stacked_userpath_numerics_ok": stacked_numerics_ok,
+        # ISSUE 9 attestation: which execution paths actually ran —
+        # the Pallas kernel verdicts (per kernel/width: "ok" after the
+        # first-use bit-exactness check, or "fallback:<reason>") and
+        # the resolved plan of the default-layout user path
+        "pallas_kernels_active": _pallas_report()["enabled"],
+        "pallas_kernels": _pallas_report()["kernels"],
+        "default_layout": os.environ.get("MOOSE_TPU_LAYOUT", "auto"),
+        "stacked_userpath_default_plan": userpath_plans,
         # the baseline ran 3 mutually-distrusting workers over gRPC;
         # this measurement executes the same protocol arithmetic in
         # ONE trust domain (one XLA program, party axis on-mesh)
@@ -845,6 +948,17 @@ def main():
         print(f"# threefry chained bench failed: {e}")
     finally:
         ring_dialect.set_prf_impl(prev_prf)
+
+    # per-kernel Pallas A/B microbench (ISSUE 9): only meaningful where
+    # the kernels are selected (TPU, or MOOSE_TPU_PALLAS=1 elsewhere —
+    # interpret-mode timings would be noise, not evidence)
+    try:
+        if _within_budget() and _pallas_report()["enabled"]:
+            record["pallas_kernel_micro_s"] = bench_pallas_kernels()
+            record["pallas_kernels"] = _pallas_report()["kernels"]
+            emit()
+    except Exception as e:
+        print(f"# pallas kernel microbench failed: {e}")
 
     # latency including full 8MB result copy to host numpy (dominated
     # by the dev-harness tunnel, not the TPU)

@@ -21,6 +21,7 @@ uses MPC rounds.
 
 from __future__ import annotations
 
+import functools as _functools
 import math
 from typing import Optional, Sequence
 
@@ -47,9 +48,12 @@ def fill_public(sess, rep, like: RepTensor, raw_value: int) -> RepTensor:
     return rep_ops.fill(sess, rep, shp, raw_value, _width_of(like))
 
 
+@_functools.lru_cache(maxsize=None)
 def encode_const(value: float, frac: int, width: int) -> int:
     """Encode a float into the ring as a two's-complement fixed-point raw
-    integer (the `as_fixedpoint` helper of the reference)."""
+    integer (the `as_fixedpoint` helper of the reference).  Memoized:
+    polynomial evaluation re-lifted every coefficient on every trace
+    (ISSUE 9 satellite)."""
     raw = int(round(value * (2 ** frac)))
     return raw % (1 << width)
 

@@ -597,6 +597,11 @@ class _SelfCheckBase:
         self._jit_fn = None
         self._per_op = None
         self._run_failed_once = False
+        # rung names visited, for the single settle-time summary log
+        # (per-rung descents log at DEBUG only — BENCH_r05's triple
+        # "candidate diverged" WARNING burst was ladder noise, not
+        # three independent problems)
+        self._descent = [self._rung_label(level)]
         self.mode = "validating"
         if mode == "eager":
             # restored from the plan registry: a previous runner for
@@ -635,6 +640,26 @@ class _SelfCheckBase:
 
     def _save_state(self):
         """Persist ladder level / pins / mode (subclass hook)."""
+
+    def _rung_label(self, level: int) -> str:
+        if level >= len(self.LADDER):
+            return "eager"
+        rung = self.LADDER[level]
+        if rung is _PER_OP:
+            return "per-op"
+        return "default-segments" if rung is None else f"{rung}-op"
+
+    def _announce_resolution(self, verdict: str, warn: bool = False):
+        """ONE log line when the ladder settles: the descent path plus
+        the final verdict — at INFO for promotions, WARNING only for
+        full exhaustion (the one genuinely bad outcome)."""
+        from ..logger import get_logger
+
+        log = get_logger().warning if warn else get_logger().info
+        log(
+            "jit self-check: ladder settled (%s) -> %s",
+            " -> ".join(self._descent), verdict,
+        )
 
     # -- state machine -----------------------------------------------------
 
@@ -686,10 +711,10 @@ class _SelfCheckBase:
             self._checks_left -= 1
             if self._checks_left <= 0:
                 self.mode = "jit"
-                get_logger().info(
-                    "jit self-check: plan promoted to segmented jit "
-                    "(segment override %s) after %d clean runs",
-                    self.LADDER[self._level], self._checks_init,
+                self._announce_resolution(
+                    f"promoted to jit (segment override "
+                    f"{self.LADDER[self._level]}) after "
+                    f"{self._checks_init} clean runs"
                 )
                 self._on_promoted()
                 self._save_state()
@@ -710,7 +735,11 @@ class _SelfCheckBase:
                 per_op_skipped = True
                 self._level += 1
                 continue
-            get_logger().warning(
+            self._descent.append(self._rung_label(self._level))
+            # rung-by-rung descent is normal ladder operation, not an
+            # actionable warning: the settle-time summary carries the
+            # verdict (ISSUE 9 satellite — BENCH_r05 warning burst)
+            get_logger().debug(
                 "jit self-check: candidate diverged from eager; retrying "
                 "with %s",
                 "per-op programs (divergent ops will be pinned eager)"
@@ -720,12 +749,14 @@ class _SelfCheckBase:
             self._run_failed_once = False
             self._save_state()
             return
-        get_logger().warning(
-            "jit self-check: every ladder rung (segment sizes and the "
-            "per-op rung%s) diverged; plan pinned to whole-plan eager "
-            "execution",
-            " — skipped: disabled or above MOOSE_TPU_PEROP_MAX"
-            if per_op_skipped else "",
+        self._descent.append("eager")
+        self._announce_resolution(
+            "every rung diverged%s; plan pinned to whole-plan eager "
+            "execution" % (
+                " (per-op rung skipped: disabled or above "
+                "MOOSE_TPU_PEROP_MAX)" if per_op_skipped else ""
+            ),
+            warn=True,
         )
         self.mode = "eager"
         self._jit_fn = None
@@ -739,16 +770,17 @@ class _SelfCheckBase:
         try:
             result, new_pins, retried = self._per_op.run_validate(*args)
         except Exception as e:  # noqa: BLE001 — candidate is optional
-            get_logger().warning(
-                "per-op jit self-check failed to run (%s); plan pinned "
-                "to eager execution", e
+            self._descent.append("eager")
+            self._announce_resolution(
+                f"per-op validation failed to run ({e}); plan pinned "
+                "to whole-plan eager execution", warn=True,
             )
             self.mode = "eager"
             self._per_op = None
             self._save_state()
             return self._eager_fn(*args)
         if new_pins:
-            get_logger().warning(
+            get_logger().debug(
                 "per-op jit self-check: pinned %d divergent op(s) "
                 "eager: %s", len(new_pins), ", ".join(sorted(new_pins)),
             )
@@ -760,20 +792,23 @@ class _SelfCheckBase:
         else:
             self._checks_left -= 1
         if self._per_op.all_pinned():
-            get_logger().warning(
-                "per-op jit self-check: every %s diverged; plan pinned "
-                "to eager execution",
-                "op" if self._per_op.seg_size == 1
-                else f"{self._per_op.seg_size}-op chunk",
+            self._descent.append("eager")
+            self._announce_resolution(
+                "every %s diverged; plan pinned to whole-plan eager "
+                "execution" % (
+                    "op" if self._per_op.seg_size == 1
+                    else f"{self._per_op.seg_size}-op chunk"
+                ),
+                warn=True,
             )
             self.mode = "eager"
             self._per_op = None
         elif self._checks_left <= 0:
             self.mode = _PER_OP
-            get_logger().info(
-                "per-op jit self-check: plan promoted with %d op(s) "
-                "pinned eager after %d clean runs",
-                len(self._per_op.pinned), self._checks_init,
+            self._announce_resolution(
+                f"promoted to per-op jit with "
+                f"{len(self._per_op.pinned)} op(s) pinned eager after "
+                f"{self._checks_init} clean runs"
             )
             self._on_promoted()
         self._save_state()

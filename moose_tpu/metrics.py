@@ -31,6 +31,14 @@ Design rules:
 ``comet --metrics-port`` (and by ``scripts/dist_smoke.py``): ``GET
 /metrics`` serves the Prometheus text, ``GET /healthz`` a JSON health
 document, ``GET /v1/metrics`` the JSON snapshot.
+
+Kernel-path attestation (ISSUE 9): ``native/ring128_kernels.py``
+registers ``moose_tpu_pallas_dispatch_total{kernel=...}`` (trace-time
+routings of a primitive into its Pallas kernel) and
+``moose_tpu_pallas_fallback_total{kernel=..., reason=...}`` (first-use
+self-check divergence/error or per-call shape rejection demoting a
+primitive to the XLA path), so BENCH/MULTICHIP rounds can attest which
+path actually ran instead of inferring it from timings.
 """
 
 from __future__ import annotations

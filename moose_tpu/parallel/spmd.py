@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..dialects import ring
+from ..native import ring128_kernels as _rk
 
 U64 = jnp.uint64
 
@@ -218,7 +219,14 @@ def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
     the contraction distributes over ring addition mod 2^w, so the
     regrouping is bit-exact while doing TWO contractions instead of
     three — a 33% cut in MXU work for the dominant phase of secure
-    mul/dot (the y-pair add is a cheap elementwise ring add)."""
+    mul/dot (the y-pair add is a cheap elementwise ring add).
+
+    The hot contractions route through the Pallas kernels of
+    ``native/ring128_kernels.py`` when selected (MOOSE_TPU_PALLAS):
+    the elementwise cross terms as ONE fused Mosaic program, the
+    party-batched dot cross terms behind the opt-in dot kernel — each
+    validated bit-exactly against this lax path on first use, with
+    per-primitive XLA fallback."""
 
     def take(t, slot):
         return (
@@ -228,7 +236,26 @@ def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
 
     x0, y0 = take(x, 0), take(y, 0)
     x1, y1 = take(x, 1), take(y, 1)
-    ys_lo, ys_hi = ring.add(*y0, *y1)
+    if contract is ring.mul and _rk.dispatch("cross_terms_mul", x.width):
+        try:
+            return _rk.cross_terms_mul(x0, x1, y0, y1, x.width)
+        except Exception as e:  # noqa: BLE001 — the kernel is an
+            # optimization; any failure keeps the exact XLA path
+            _rk.record_fallback("cross_terms_mul", x.width, "error", e)
+    ys_pair = None
+    if contract is _dot_contract and _rk.dispatch(
+        "dot_cross_terms", x.width
+    ):
+        ys_pair = ring.add(*y0, *y1)
+        try:
+            return _rk.dot_cross_terms(x0, x1, y0, ys_pair, x.width)
+        except _rk.ShapeUnsupported:
+            pass  # this shape only; the (kernel, width) verdict stands
+        except Exception as e:  # noqa: BLE001
+            _rk.record_fallback("dot_cross_terms", x.width, "error", e)
+    ys_lo, ys_hi = (
+        ys_pair if ys_pair is not None else ring.add(*y0, *y1)
+    )
     v_lo, v_hi = contract(*x0, ys_lo, ys_hi)
     t_lo, t_hi = contract(*x1, *y0)
     return ring.add(v_lo, v_hi, t_lo, t_hi)
@@ -329,6 +356,17 @@ def fx_conv2d(sess, x: "SpmdFixed", k: "SpmdFixed",
 
 def mul_public(x: SpmdRep, c_lo, c_hi) -> SpmdRep:
     """x * public constant (same value on every party)."""
+    if _rk.dispatch("ring_mul", x.width):
+        try:
+            b_lo = jnp.broadcast_to(c_lo, x.lo.shape)
+            b_hi = (
+                None if x.hi is None
+                else jnp.broadcast_to(c_hi, x.hi.shape)
+            )
+            lo, hi = _rk.ring_mul(x.lo, x.hi, b_lo, b_hi, x.width)
+            return SpmdRep(lo, hi, x.width)
+        except Exception as e:  # noqa: BLE001 — kernel optional
+            _rk.record_fallback("ring_mul", x.width, "error", e)
     lo, hi = ring.mul(x.lo, x.hi, c_lo, c_hi)
     return SpmdRep(lo, hi, x.width)
 
@@ -472,33 +510,57 @@ def trunc_pr(sess: SpmdSession, x: SpmdRep, amount: int) -> SpmdRep:
     # rep -> 2-party additive: a0 = x0 + x1 (party 0 holds both), a1 = x2.
     a0 = ring.add(x.lo[0, 0], h(x.hi, 0, 0), x.lo[0, 1], h(x.hi, 0, 1))
     a1 = (x.lo[1, 1], h(x.hi, 1, 1))
-    return _trunc_pr_adt(sess, a0, a1, x.width, amount, x.shape, x.hi is not None)
+    return _trunc_pr_adt(sess, a0, a1, x.width, amount, x.shape)
 
 
-def _trunc_pr_adt(sess, a0, a1, width, amount, shape, has_hi) -> SpmdRep:
+def _trunc_pr_adt(sess, a0, a1, width, amount, shape) -> SpmdRep:
     """Probabilistic truncation from a 2-party additive sharing
     (a0 + a1 = x): the shared core of :func:`trunc_pr` and the fused
     multiply-then-truncate paths, which feed the additive sharing
     straight from the cross products + zero-share without materializing
-    the intermediate replicated pair layout."""
+    the intermediate replicated pair layout.
+
+    The five PRF draws (mask r, the three additive-share masks, the
+    replicated-compression share z0) happen HERE, in the historical
+    session order, so the pure elementwise tail can dispatch to the
+    fused Pallas kernel or its lax twin interchangeably — both consume
+    identical randomness and are bit-identical."""
+    draws = tuple(sess.sample(shape, width) for _ in range(5))
+    z_lo, z_hi = _trunc_combine(a0, a1, draws, width, amount)
+    return _pairs(z_lo, z_hi, width)
+
+
+def _trunc_combine(a0, a1, draws, width, amount):
+    if _rk.dispatch("trunc_combine", width):
+        try:
+            return _rk.trunc_combine(
+                a0, a1, draws, width, amount, a0[0].shape
+            )
+        except Exception as e:  # noqa: BLE001 — the kernel is an
+            # optimization; any failure keeps the exact XLA path
+            _rk.record_fallback("trunc_combine", width, "error", e)
+    return _trunc_combine_lax(a0, a1, draws, width, amount)
+
+
+def _trunc_combine_lax(a0, a1, draws, width, amount):
+    """The elementwise tail of probabilistic truncation given its five
+    PRF draws — the historical ``_trunc_pr_adt`` math with the draws
+    hoisted out (the Pallas kernel's lax twin).  Returns the stacked
+    (3, *shape) replicated values (z0, z1, y1) as (z_lo, z_hi)."""
     k = width - 1
     a0_lo, a0_hi = a0
     a1_lo, a1_hi = a1
+    (r_lo, r_hi), r0, rt0, rm0, (z0_lo, z0_hi) = draws
+    shape = r_lo.shape
 
-    # provider (party 2) samples the masks and additively shares them
-    r_lo, r_hi = sess.sample(shape, width)
+    # provider (party 2)'s mask and its derived top/msb parts,
+    # additively shared against the pre-drawn masks
     r_msb_lo, r_msb_hi = ring.shr(r_lo, r_hi, width - 1)
     t_lo, t_hi = ring.shl(r_lo, r_hi, 1)
     r_top_lo, r_top_hi = ring.shr(t_lo, t_hi, amount + 1)
-
-    def adt_share(v_lo, v_hi):
-        m_lo, m_hi = sess.sample(shape, width)
-        d_lo, d_hi = ring.sub(v_lo, v_hi, m_lo, m_hi)
-        return (m_lo, m_hi), (d_lo, d_hi)
-
-    (r0, r1) = adt_share(r_lo, r_hi)
-    (rt0, rt1) = adt_share(r_top_lo, r_top_hi)
-    (rm0, rm1) = adt_share(r_msb_lo, r_msb_hi)
+    r1 = ring.sub(r_lo, r_hi, r0[0], r0[1])
+    rt1 = ring.sub(r_top_lo, r_top_hi, rt0[0], rt0[1])
+    rm1 = ring.sub(r_msb_lo, r_msb_hi, rm0[0], rm0[1])
 
     ones_lo, ones_hi = ring.fill_like_shape(shape, width, 1)
     up_lo, up_hi = ring.shl(ones_lo, ones_hi, k - 1)
@@ -544,13 +606,13 @@ def _trunc_pr_adt(sess, a0, a1, width, amount, shape, has_hi) -> SpmdRep:
     y1_lo, y1_hi = ring.add(y1_lo, y1_hi, of1[0], of1[1])
 
     # additive -> replicated (PRF-compressed): z0 = PRF, z1 = y0 - z0, z2 = y1
-    z0_lo, z0_hi = sess.sample(shape, width)
     z1_lo, z1_hi = ring.sub(y0_lo, y0_hi, z0_lo, z0_hi)
     z_lo = jnp.stack([z0_lo, z1_lo, y1_lo], axis=0)
     z_hi = (
-        jnp.stack([z0_hi, z1_hi, y1_hi], axis=0) if has_hi else None
+        jnp.stack([z0_hi, z1_hi, y1_hi], axis=0)
+        if z0_hi is not None else None
     )
-    return _pairs(z_lo, z_hi, width)
+    return z_lo, z_hi
 
 
 def _mul_like_trunc(sess, x, y, contract, amount: int) -> SpmdRep:
@@ -572,9 +634,7 @@ def _mul_like_trunc(sess, x, y, contract, amount: int) -> SpmdRep:
 
     a0 = ring.add(z_lo[0], h(z_hi, 0), z_lo[1], h(z_hi, 1))
     a1 = (z_lo[2], h(z_hi, 2))
-    return _trunc_pr_adt(
-        sess, a0, a1, width, amount, z_lo.shape[1:], z_hi is not None
-    )
+    return _trunc_pr_adt(sess, a0, a1, width, amount, z_lo.shape[1:])
 
 
 # ---------------------------------------------------------------------------

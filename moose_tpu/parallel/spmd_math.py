@@ -30,6 +30,7 @@ whole remaining tensor regardless of fan-in.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Optional, Sequence
 
@@ -39,6 +40,7 @@ import numpy as np
 
 from ..dialects import ring
 from ..dialects.fixedpoint import P_1045, P_2524, Q_2524, encode_const
+from ..native import ring128_kernels as _rk
 from . import spmd
 from .spmd import SpmdFixed, SpmdRep, SpmdSession
 
@@ -95,17 +97,31 @@ def bits_not(x: SpmdBits) -> SpmdBits:
     return SpmdBits(arr)
 
 
-def bits_and(sess: SpmdSession, x: SpmdBits, y: SpmdBits) -> SpmdBits:
-    """AND = multiplication over Z_2: local cross terms + XOR zero-share
-    + reshare roll (stacked ``replicated.and_bits``)."""
+def _bits_and_bank(x: SpmdBits, y: SpmdBits, bank) -> SpmdBits:
+    """AND = multiplication over Z_2 with the PRF draw hoisted out:
+    local cross terms + XOR zero-share from ``bank`` + reshare roll
+    (stacked ``replicated.and_bits``).  Pure given the bank, so the
+    fused Pallas adder and its lax twin can both consume pre-drawn
+    banks bit-identically."""
     x0, x1 = x.arr[:, 0], x.arr[:, 1]
     y0, y1 = y.arr[:, 0], y.arr[:, 1]
     # regrouped cross terms (AND distributes over XOR): one fewer AND
     v = (x0 & (y0 ^ y1)) ^ (x1 & y0)
-    s = sess.sample_bit_bank(v.shape[1:])
-    alpha = s ^ jnp.roll(s, -1, axis=0)
+    alpha = bank ^ jnp.roll(bank, -1, axis=0)
     z = v ^ alpha
     return SpmdBits(jnp.stack([z, jnp.roll(z, -1, axis=0)], axis=1))
+
+
+def bits_and(sess: SpmdSession, x: SpmdBits, y: SpmdBits) -> SpmdBits:
+    """AND = multiplication over Z_2: local cross terms + XOR zero-share
+    + reshare roll (stacked ``replicated.and_bits``).  The bank shape
+    is the BROADCAST of the operands (historical draw shape — operands
+    may differ after logical-rank alignment); the math delegates to the
+    single bank-consuming core."""
+    v_shape = jnp.broadcast_shapes(
+        x.arr[:, 0].shape, y.arr[:, 0].shape
+    )[1:]
+    return _bits_and_bank(x, y, sess.sample_bit_bank(v_shape))
 
 
 def bits_or(sess: SpmdSession, x: SpmdBits, y: SpmdBits) -> SpmdBits:
@@ -133,10 +149,19 @@ def _bit_slice(x: SpmdBits, start: int, stop: int) -> SpmdBits:
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _bit_shift_table(nd: int):
+    """Memoized (64, 1...) shift iota for :func:`_plain_bits` — rebuilt
+    on every trace before, bloating whole-graph jit time (ISSUE 9
+    satellite).  A NUMPY constant on purpose: a cached jnp array minted
+    inside one jit trace would leak its tracer into every later
+    caller.  Read-only."""
+    return np.arange(64, dtype=np.uint64).reshape((64,) + (1,) * nd)
+
+
 def _plain_bits(lo, hi, width: int):
     """Bit-planes of the held ring shares: (3, 2, k, *shape) uint8."""
-    nd = lo.ndim - 2
-    shifts = jnp.arange(64, dtype=U64).reshape((64,) + (1,) * nd)
+    shifts = _bit_shift_table(lo.ndim - 2)
     lo_b = ((lo[:, :, None] >> shifts) & jnp.uint64(1)).astype(U8)
     if width == 64:
         return lo_b
@@ -144,36 +169,99 @@ def _plain_bits(lo, hi, width: int):
     return jnp.concatenate([lo_b, hi_b], axis=2)
 
 
+@functools.lru_cache(maxsize=None)
 def _summand_mask(j: int, ndim: int, dtype=np.uint8):
     """Static (3, 2, 1...) mask selecting the pair slots that hold
-    summand x_j: (party j, slot 0) and (party j-1, slot 1)."""
+    summand x_j: (party j, slot 0) and (party j-1, slot 1).  Memoized —
+    callers treat the array as read-only."""
     m = np.zeros((3, 2), dtype)
     m[j, 0] = 1
     m[(j - 1) % 3, 1] = 1
     return m.reshape((3, 2) + (1,) * (ndim - 2))
 
 
+
+
+def _kogge_stone_banks(x: SpmdBits, y: SpmdBits, k: int,
+                       next_bank) -> SpmdBits:
+    """Carry-lookahead adder consuming pre-drawn AND banks from
+    ``next_bank()`` — the pure core shared by :func:`kogge_stone`, the
+    fused Pallas adder's lax twin, and its fallback path (identical
+    bank-consumption order is what makes them bit-interchangeable)."""
+    p = bits_xor(x, y)
+    g = _bits_and_bank(x, y, next_bank())
+    p_run = p
+    d = 1
+    while d < k:
+        g = bits_xor(g, _bits_and_bank(p_run, shl_bits(g, d), next_bank()))
+        if d * 2 < k:  # final p_run would be dead
+            p_run = _bits_and_bank(p_run, shl_bits(p_run, d), next_bank())
+        d *= 2
+    return bits_xor(p, shl_bits(g, 1))
+
+
 def kogge_stone(sess, x: SpmdBits, y: SpmdBits, k: int) -> SpmdBits:
     """Carry-lookahead adder on stacked bit shares: log2(k) rounds of two
     ANDs over the whole tensor (vs the reference's k-round ripple adder,
     replicated/misc.rs:176)."""
-    p = bits_xor(x, y)
-    g = bits_and(sess, x, y)
-    p_run = p
-    d = 1
-    while d < k:
-        g = bits_xor(g, bits_and(sess, p_run, shl_bits(g, d)))
-        if d * 2 < k:  # final p_run would be dead
-            p_run = bits_and(sess, p_run, shl_bits(p_run, d))
-        d *= 2
-    return bits_xor(p, shl_bits(g, 1))
+    return _kogge_stone_banks(
+        x, y, k,
+        lambda: sess.sample_bit_bank(x.arr[:, 0].shape[1:]),
+    )
+
+
+def _draw_adder_banks(sess: SpmdSession, x: SpmdRep):
+    """Pre-draw the fused decompose/adder's AND banks in the exact
+    order the unfused path would (2 carry-save + the Kogge-Stone
+    rounds), stacked (n_ands, 3, k, *shape) uint8."""
+    bank_shape = (x.width,) + tuple(x.shape)
+    return jnp.stack([
+        sess.sample_bit_bank(bank_shape)
+        for _ in range(_rk.adder_bank_count(x.width))
+    ])
+
+
+def _bit_decompose_with_banks(lo, hi, width: int, banks):
+    """Lax twin of the fused Pallas ``bit_decompose`` kernel: the
+    unfused carry-save + Kogge-Stone path consuming the same pre-drawn
+    bank stack in the same order.  Returns the raw (3, 2, k, *shape)
+    uint8 bit-share array."""
+    B = _plain_bits(lo, hi, width)
+    b0, b1, b2 = (SpmdBits(B * _summand_mask(j, B.ndim)) for j in range(3))
+    counter = iter(range(banks.shape[0]))
+
+    def next_bank():
+        return banks[next(counter)]
+
+    s = bits_xor(bits_xor(b0, b1), b2)
+    c = bits_xor(
+        _bits_and_bank(b0, b1, next_bank()),
+        _bits_and_bank(bits_xor(b0, b1), b2, next_bank()),
+    )
+    return _kogge_stone_banks(s, shl_bits(c, 1), width, next_bank).arr
 
 
 def bit_decompose(sess: SpmdSession, x: SpmdRep) -> SpmdBits:
     """Arithmetic -> binary sharing: x = x_0 + x_1 + x_2 with each
     summand trivially XOR-shared (statically masked bit-planes), then a
     carry-save step + one Kogge-Stone adder.  Returns bits with a
-    leading bit axis of length k at array axis 2."""
+    leading bit axis of length k at array axis 2.
+
+    With Pallas kernels selected the whole thing — bit-plane
+    extraction, masks, carry-save, adder — runs as ONE Mosaic program
+    consuming pre-drawn AND banks; the unfused path draws the identical
+    bank sequence, so the two are bit-interchangeable."""
+    if _rk.dispatch("bit_decompose", x.width):
+        banks = _draw_adder_banks(sess, x)
+        try:
+            return SpmdBits(
+                _rk.bit_decompose(x.lo, x.hi, x.width, banks)
+            )
+        except Exception as e:  # noqa: BLE001 — kernel optional
+            _rk.record_fallback("bit_decompose", x.width, "error", e)
+        return SpmdBits(
+            _bit_decompose_with_banks(x.lo, x.hi, x.width, banks)
+        )
     B = _plain_bits(x.lo, x.hi, x.width)
     b0, b1, b2 = (SpmdBits(B * _summand_mask(j, B.ndim)) for j in range(3))
     # carry-save: s = b0^b1^b2 ; c = ((b0&b1) ^ ((b0^b1)&b2)) << 1
@@ -193,7 +281,9 @@ def b2a(sess: SpmdSession, bits: SpmdBits, width: int) -> SpmdRep:
     lo_all = bits.arr.astype(U64)
     parts = []
     for j in range(3):
-        m = jnp.asarray(_summand_mask(j, bits.arr.ndim, np.uint64))
+        # the memoized numpy mask broadcasts directly (no per-trace
+        # jnp.asarray upload)
+        m = _summand_mask(j, bits.arr.ndim, np.uint64)
         lo = lo_all * m
         hi = jnp.zeros_like(lo) if width == 128 else None
         parts.append(SpmdRep(lo, hi, width))
@@ -206,15 +296,37 @@ def b2a(sess: SpmdSession, bits: SpmdBits, width: int) -> SpmdRep:
     return arith_xor(arith_xor(a0, a1), a2)
 
 
+@functools.lru_cache(maxsize=None)
+def _weight_consts(weights: tuple, width: int, nd: int):
+    """Memoized public-weight ring constants for
+    :func:`weighted_bit_sum` — the object-dtype vectorized lift was
+    rebuilt on every trace (ISSUE 9 satellite).  Read-only."""
+    w = np.asarray([int(v) for v in weights], object).reshape(
+        (len(weights),) + (1,) * nd
+    )
+    # pure-numpy lift (the np half of ring.from_python_ints): jnp would
+    # return a tracer under an active trace, which a cache must never
+    # hold
+    lo = np.vectorize(
+        lambda v: int(v) & 0xFFFFFFFFFFFFFFFF, otypes=[np.uint64]
+    )(w)
+    if width == 64:
+        return lo, None
+    hi = np.vectorize(
+        lambda v: (int(v) >> 64) & 0xFFFFFFFFFFFFFFFF,
+        otypes=[np.uint64],
+    )(w)
+    return lo, hi
+
+
 def weighted_bit_sum(ring_bits: SpmdRep, weights: Sequence[int]) -> SpmdRep:
     """sum_i ring_bits[i] * weights[i] along the leading (bit) logical
     axis, public integer weights."""
     width = ring_bits.width
     nd = len(ring_bits.shape) - 1
-    w = np.asarray([int(v) for v in weights], object).reshape(
-        (len(weights),) + (1,) * nd
+    w_lo, w_hi = _weight_consts(
+        tuple(int(v) for v in weights), width, nd
     )
-    w_lo, w_hi = ring.from_python_ints(w, width)
     z = spmd.mul_public(ring_bits, w_lo, w_hi)
     return spmd.sum_axis(z, 0)
 
@@ -230,6 +342,16 @@ def bit_compose(sess, bits: SpmdBits, width: int) -> SpmdRep:
 
 
 def msb(sess: SpmdSession, x: SpmdRep) -> SpmdBits:
+    if _rk.dispatch("msb", x.width):
+        # same fused program as bit_decompose but only the top bit
+        # plane leaves VMEM (comparisons need nothing else)
+        banks = _draw_adder_banks(sess, x)
+        try:
+            return SpmdBits(_rk.msb(x.lo, x.hi, x.width, banks))
+        except Exception as e:  # noqa: BLE001 — kernel optional
+            _rk.record_fallback("msb", x.width, "error", e)
+        arr = _bit_decompose_with_banks(x.lo, x.hi, x.width, banks)
+        return SpmdBits(arr[:, :, x.width - 1])
     bits = bit_decompose(sess, x)
     return SpmdBits(bits.arr[:, :, x.width - 1])
 
@@ -416,29 +538,110 @@ def fx_add_public_raw(x: SpmdFixed, raw: int) -> SpmdFixed:
     )
 
 
+class _ReplaySession:
+    """Feeds PRE-DRAWN randomness back to protocol code verbatim: the
+    Pallas kernels' lax twins and error fallbacks re-run the ORIGINAL
+    unfused code on exactly the draws the kernel consumed, so the two
+    paths are bit-identical by construction (never used for fresh
+    randomness — only to replay a sequence another path drew)."""
+
+    def __init__(self, queue):
+        self._queue = list(queue)
+
+    def sample(self, shape, width):
+        return self._queue.pop(0)
+
+    def sample_bank(self, shape, width):
+        return self._queue.pop(0)
+
+    def sample_bit_bank(self, shape):
+        return self._queue.pop(0)
+
+
+def _horner_lax(sess, x: SpmdRep, raws: Sequence[int], f: int) -> SpmdRep:
+    """Unfused Horner ladder over raw encoded coefficients (highest
+    first; raws[0] seeds the accumulator as a trivial public sharing) —
+    the core of :func:`polynomial_eval` and the lax twin / fallback of
+    the fused Pallas ``horner`` kernel."""
+    acc = spmd.fill_public(x.shape, x.width, raws[0])
+    for raw in raws[1:]:
+        z = spmd._mul_like_trunc(sess, acc, x, ring.mul, f)
+        acc = add_public_raw(z, raw)
+    return acc
+
+
 def polynomial_eval(
     sess, coeffs: Sequence[float], x: SpmdFixed, min_coeff=None
 ) -> SpmdFixed:
     """Horner with public coefficients; sub-precision tail coefficients
-    dropped (as the reference does) to bound the degree."""
+    dropped (as the reference does) to bound the degree.
+
+    With Pallas kernels selected the whole ladder — every step's cross
+    terms, zero-share, probabilistic truncation, and coefficient add —
+    runs as ONE fused Mosaic program (``ring128_kernels.horner``): this
+    is the exp/sigmoid polynomial region where the TPU whole-program
+    miscompile actually bites (DEVELOP.md localization), so keeping XLA
+    out of its fusion decisions entirely is the point.  Randomness is
+    pre-drawn in the unfused path's exact order, so results are
+    bit-identical with the kernel on or off."""
     f = x.fractional_precision
     width = x.tensor.width
     eps = max(2.0 ** -(f + 1), min_coeff or 0.0)
     top = len(coeffs)
     while top > 1 and abs(coeffs[top - 1]) < eps:
         top -= 1
-    acc = None
-    for c in reversed(list(coeffs[:top])):
-        raw = encode_const(c, f, width)
-        if acc is None:
-            acc = SpmdFixed(
-                spmd.fill_public(x.tensor.shape, width, raw),
-                x.integral_precision,
-                f,
+    raws = [
+        encode_const(c, f, width)
+        for c in reversed(list(coeffs[:top]))
+    ]
+    steps = len(raws) - 1
+    t = x.tensor
+    if steps == 0:
+        return SpmdFixed(
+            spmd.fill_public(t.shape, width, raws[0]),
+            x.integral_precision, f,
+        )
+    if _rk.dispatch("horner", width):
+        shape = t.shape
+        queue = []
+        zb, td = [], []
+        for _ in range(steps):
+            bank = sess.sample_bank(shape, width)
+            queue.append(bank)
+            zb.append(bank)
+            ds = [sess.sample(shape, width) for _ in range(5)]
+            queue.extend(ds)
+            td.append(ds)
+        zbanks = (
+            jnp.stack([b[0] for b in zb]),
+            None if width == 64 else jnp.stack([b[1] for b in zb]),
+        )
+        tdraws = (
+            jnp.stack([jnp.stack([d[0] for d in ds]) for ds in td]),
+            None if width == 64 else jnp.stack(
+                [jnp.stack([d[1] for d in ds]) for ds in td]
+            ),
+        )
+        try:
+            (s0_lo, s0_hi), (s1_lo, s1_hi) = _rk.horner(
+                (t.lo[:, 0], None if t.hi is None else t.hi[:, 0]),
+                (t.lo[:, 1], None if t.hi is None else t.hi[:, 1]),
+                width, raws, f, zbanks, tdraws, shape,
             )
-        else:
-            acc = fx_add_public_raw(spmd.fx_mul(sess, acc, x), raw)
-    return acc
+            lo = jnp.stack([s0_lo, s1_lo], axis=1)
+            hi = (
+                None if width == 64
+                else jnp.stack([s0_hi, s1_hi], axis=1)
+            )
+            acc = SpmdRep(lo, hi, width)
+        except Exception as e:  # noqa: BLE001 — kernel optional;
+            # replay the SAME draws through the unfused ladder
+            _rk.record_fallback("horner", width, "error", e)
+            acc = _horner_lax(_ReplaySession(queue), t, raws, f)
+        return SpmdFixed(acc, x.integral_precision, f)
+    return SpmdFixed(
+        _horner_lax(sess, t, raws, f), x.integral_precision, f
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -47,23 +47,31 @@ class LocalMooseRuntime:
         if use_jit is None:
             use_jit = os.environ.get("MOOSE_TPU_JIT", "1") != "0"
         # execution layout for replicated protocol math:
+        #   "auto" (default) — stacked-where-supported: graphs with
+        #     replicated-placement ops route through the party-stacked
+        #     backend when ``stacked_dialect.supports()`` admits them,
+        #     and demote to per-host on rejection or validated-jit
+        #     ladder exhaustion (the reference has ONE lowering
+        #     pipeline; with the Pallas ring kernels closing the
+        #     fixed(24,40) miscompile, stacked is the fast default
+        #     rather than an opt-in — ROADMAP item 1);
         #   "per-host" — six separately-labelled per-party arrays
-        #     (dialects/logical.py), the lowering-compatible default;
+        #     (dialects/logical.py), the lowering-compatible layout;
         #   "stacked" — party-stacked SPMD arrays (dialects/stacked.py):
         #     one (party=3, slot=2, ...) array per sharing, reshares as
         #     rolls/collective-permutes, shardable over a device mesh
         #     (pass ``mesh=spmd.make_mesh(...)``).  Graphs with ops the
         #     stacked dialect does not cover fall back to per-host.
         if layout is None:
-            layout = os.environ.get("MOOSE_TPU_LAYOUT", "per-host")
-        if layout not in ("per-host", "stacked"):
+            layout = os.environ.get("MOOSE_TPU_LAYOUT", "auto")
+        if layout not in ("auto", "per-host", "stacked"):
             raise ValueError(
-                f"unknown layout {layout!r}; expected 'per-host' or "
-                "'stacked'"
+                f"unknown layout {layout!r}; expected 'auto', "
+                "'per-host' or 'stacked'"
             )
         self.layout = layout
         self._stacked = None
-        if layout == "stacked":
+        if layout in ("auto", "stacked"):
             from .dialects.stacked import StackedDialect
 
             self._stacked = Interpreter(
@@ -187,6 +195,10 @@ class LocalMooseRuntime:
             if (
                 not lowered
                 and computation not in self._stacked_rejected
+                and (
+                    self.layout == "stacked"
+                    or self._wants_stacked(computation)
+                )
                 and stacked_dialect.supports(computation)
             ):
                 if self._stacked.plan_exhausted(
@@ -317,6 +329,22 @@ class LocalMooseRuntime:
             self._interpreter.last_plan_info or {}, layout="per-host"
         )
         return result
+
+    @staticmethod
+    def _wants_stacked(computation) -> bool:
+        """Under layout='auto', only graphs with replicated-placement
+        ops gain anything from the stacked backend — host-only graphs
+        keep the per-host path (identical kernels, no conversion
+        layer).  Explicit layout='stacked' skips this screen."""
+        from .computation import ReplicatedPlacement
+
+        return any(
+            isinstance(
+                computation.placements.get(op.placement_name),
+                ReplicatedPlacement,
+            )
+            for op in computation.operations.values()
+        )
 
     # Rough lowered-size weights for replicated-placement math ops
     # Rough lowered-size weights (host-op equivalents; see

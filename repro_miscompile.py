@@ -81,16 +81,28 @@ XLA_BISECT_CONFIGS = (
 )
 
 
-def sigmoid_probe(precision, batch: int, dump_hlo=None) -> int:
+def sigmoid_probe(precision, batch: int, dump_hlo=None,
+                  pallas: bool = False) -> int:
     """One jit-vs-eager comparison of the stacked protocol sigmoid
     under the CURRENT process environment (XLA_FLAGS already applied).
     The computation is deterministic given the fixed master key, so any
-    difference is a miscompile.  Returns the exit code."""
+    difference is a miscompile.  Returns the exit code.
+
+    ``pallas=True`` forces the ring128 Pallas kernels on (ISSUE 9): the
+    hot primitives become opaque Mosaic programs XLA cannot re-fuse, so
+    this probe doubles as the regression guard that the kernel path is
+    bit-exact under whole-graph jit — the sidestep for the very
+    miscompile this file reproduces."""
     import moose_tpu  # noqa: F401  (x64 + plugin setup)
     import jax
 
     from moose_tpu.parallel import spmd
     from moose_tpu.parallel import spmd_math as sm
+
+    if pallas:
+        from moose_tpu.native import ring128_kernels
+
+        ring128_kernels.set_enabled(True)
 
     integ, frac = precision
     # Goldschmidt division inside the protocol sigmoid needs
@@ -108,6 +120,10 @@ def sigmoid_probe(precision, batch: int, dump_hlo=None) -> int:
     print(f"backend: {jax.default_backend()}  fixed({integ},{frac}) "
           f"ring{width}  XLA_FLAGS={os.environ.get('XLA_FLAGS', '')!r}",
           flush=True)
+    if pallas:
+        from moose_tpu.native import ring128_kernels
+
+        print(f"pallas kernels: {ring128_kernels.report()}", flush=True)
     eager = np.asarray(forward(mk, x))
     jfn = jax.jit(forward)
     if dump_hlo:
@@ -115,6 +131,20 @@ def sigmoid_probe(precision, batch: int, dump_hlo=None) -> int:
             fh.write(jfn.lower(mk, x).as_text())
         print(f"HLO written to {dump_hlo}")
     jitted = np.asarray(jfn(mk, x))
+    if pallas:
+        # guard against a vacuous pass: if every kernel fell back, this
+        # probe re-tested the plain XLA path and proves nothing about
+        # the Pallas route it exists to guard
+        from moose_tpu.native import ring128_kernels
+
+        verdicts = ring128_kernels.report()["kernels"]
+        bad = {k: v for k, v in verdicts.items() if v != "ok"}
+        if not verdicts or bad:
+            print(
+                "FAIL: --pallas requested but the kernel path did not "
+                f"run cleanly: {bad or 'no kernel dispatched'}"
+            )
+            return 1
     if np.array_equal(eager, jitted):
         print("PASS: jitted fx_sigmoid bit-identical to eager")
         return 0
@@ -235,13 +265,20 @@ def main():
     parser.add_argument("--dump-hlo", default=None, metavar="PATH",
                         help="with --sigmoid-probe: write the jitted "
                         "program's HLO text to PATH")
+    parser.add_argument("--pallas", action="store_true",
+                        help="with --sigmoid-probe: force the ring128 "
+                        "Pallas kernels on (MOOSE_TPU_PALLAS override) "
+                        "— the regression guard for the kernel "
+                        "sidestep of this miscompile")
     args = parser.parse_args()
     integ, frac = (int(p) for p in args.precision.split(","))
 
     if args.platform and (args.sigmoid_probe or args.xla_bisect):
         os.environ["JAX_PLATFORMS"] = args.platform
     if args.sigmoid_probe:
-        return sigmoid_probe((integ, frac), args.batch, args.dump_hlo)
+        return sigmoid_probe(
+            (integ, frac), args.batch, args.dump_hlo, pallas=args.pallas
+        )
     if args.xla_bisect:
         return xla_bisect((integ, frac), args.batch, args.platform)
 
