@@ -752,6 +752,187 @@ def bench_logreg_serving(clients=64, requests_per_client=6, features=100,
     return per_sec_concurrent, per_sec_single, snap
 
 
+def bench_fleet_serving(replicas=3, clients=48, requests_per_client=6,
+                        features=100, max_batch=64):
+    """Fleet-serving bench (ISSUE 11 acceptance, BENCH_r06+): N replica
+    InferenceServers behind real blitzen HTTP front ends and the donner
+    routing core, all in one process so they share the accelerator.
+
+    Measures: ``serving_fleet_per_sec`` (closed-loop clients through
+    the router), request p99/p99.9, the durable-snapshot timings
+    (save, per-replica restore/re-warm — the "cold-start warm in
+    seconds" claim), and the graceful-drain duration of one replica
+    under load with ZERO failed requests (the router resolves every
+    retryable 503 on the surviving replicas)."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from sklearn.linear_model import LogisticRegression
+
+    from moose_tpu import predictors
+    from moose_tpu.bin.blitzen import ReplicaLifecycle, _make_handler
+    from moose_tpu.bin.donner import FleetConfig, Router
+    from moose_tpu.predictors.sklearn_export import logistic_regression_onnx
+    from moose_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(11)
+    x_train = rng.normal(size=(256, features))
+    y_train = (rng.uniform(size=256) > 0.5).astype(int)
+    sk = LogisticRegression().fit(x_train, y_train)
+    model = predictors.from_onnx(
+        logistic_regression_onnx(sk, features).encode()
+    )
+    config = ServingConfig.from_env(
+        max_batch=max_batch, max_wait_ms=2.0, queue_bound=4096
+    )
+    buckets = (1, max_batch)
+    record = {}
+
+    import tempfile
+
+    snapdir = tempfile.mkdtemp(prefix="bench_fleet_snap_")
+    servers, httpds, lifecycles = [], [], []
+    try:
+        # replica 0 registers fresh and writes the durable snapshot;
+        # the rest cold-start FROM it (the fleet story: one replica
+        # pays the warmup, every later replica re-warms in seconds)
+        t0 = time.perf_counter()
+        first = InferenceServer(config=config)
+        first.register_model(
+            "logreg", model, row_shape=(features,), buckets=buckets
+        )
+        record["fleet_fresh_register_s"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        first.save_snapshot(snapdir, source_digests={"logreg": "bench"})
+        record["fleet_snapshot_save_s"] = time.perf_counter() - t0
+        servers.append(first)
+        rewarms = []
+        for _ in range(replicas - 1):
+            t0 = time.perf_counter()
+            restored = InferenceServer(config=config)
+            restored.load_snapshot(
+                snapdir, source_digests={"logreg": "bench"}
+            )
+            rewarms.append(time.perf_counter() - t0)
+            servers.append(restored)
+        record["fleet_rewarm_s"] = (
+            float(np.median(rewarms)) if rewarms else None
+        )
+        for server in servers:
+            lifecycle = ReplicaLifecycle()
+            httpd = ThreadingHTTPServer(
+                ("127.0.0.1", 0), _make_handler(server, lifecycle)
+            )
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+            httpds.append(httpd)
+            lifecycles.append(lifecycle)
+        urls = [
+            f"http://127.0.0.1:{h.server_port}" for h in httpds
+        ]
+        router = Router(
+            urls,
+            config=FleetConfig(
+                probe_interval_ms=100.0, eject_after=2,
+                readmit_after=1, max_attempts=6, backoff_ms=5.0,
+            ),
+        )
+        router.start()
+        import json as json_mod
+
+        for replica in router.replicas:  # first probes race the loop
+            router.probe_once(replica)
+
+        rows = rng.normal(size=(clients, requests_per_client, features))
+        latencies = []
+        lat_lock = threading.Lock()
+
+        def run_closed_loop(tag):
+            failures = []
+            barrier = threading.Barrier(clients + 1)
+
+            def client(ci):
+                try:
+                    barrier.wait()
+                    for ri in range(requests_per_client):
+                        body = json_mod.dumps(
+                            {"x": rows[ci, ri][np.newaxis].tolist()}
+                        ).encode()
+                        t_req = time.perf_counter()
+                        status, payload, _ = router.forward(
+                            "/v1/models/logreg:predict", body, {}
+                        )
+                        if status != 200:
+                            raise RuntimeError(
+                                f"{tag}: HTTP {status}: {payload[:120]}"
+                            )
+                        with lat_lock:
+                            latencies.append(
+                                time.perf_counter() - t_req
+                            )
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    failures.append(repr(e))
+
+            threads = [
+                threading.Thread(target=client, args=(ci,))
+                for ci in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if failures:
+                raise RuntimeError(
+                    f"fleet clients failed: {failures[:3]}"
+                )
+            return clients * requests_per_client / elapsed
+
+        run_closed_loop("warm")  # warm every replica's buckets
+        with lat_lock:
+            latencies.clear()
+        record["serving_fleet_per_sec"] = run_closed_loop("timed")
+        with lat_lock:
+            lat = sorted(latencies)
+        record["serving_fleet_p99_s"] = lat[
+            min(len(lat) - 1, int(len(lat) * 0.99))
+        ]
+        record["serving_fleet_p999_s"] = lat[
+            min(len(lat) - 1, int(len(lat) * 0.999))
+        ]
+
+        # graceful drain under load: flip one replica to draining
+        # mid-loop and time until its queues empty; the router must
+        # resolve every resulting retryable 503 on the survivors
+        drain_box = {}
+
+        def drain_one():
+            time.sleep(0.2)  # let the loop land requests everywhere
+            lifecycles[-1].start_drain()
+            t_drain = time.perf_counter()
+            servers[-1].drain(timeout_s=60.0)
+            drain_box["drain_s"] = time.perf_counter() - t_drain
+
+        drainer = threading.Thread(target=drain_one)
+        drainer.start()
+        per_sec_during_drain = run_closed_loop("drain")
+        drainer.join(timeout=120)
+        record["fleet_drain_s"] = drain_box.get("drain_s")
+        record["fleet_per_sec_during_drain"] = per_sec_during_drain
+        record["fleet_replicas"] = replicas
+        router.stop()
+    finally:
+        for httpd in httpds:
+            httpd.shutdown()
+            httpd.server_close()
+        for server in servers:
+            server.close()
+    return record
+
+
 def _chained_secure_dot_s(mk, da, db, t_iters=10):
     """Amortized per-dot seconds with T secure dots chained inside ONE
     jit program (lax.scan, fresh per-step session keys, scalar readback):
@@ -1003,6 +1184,17 @@ def main():
             emit()
     except Exception as e:
         print(f"# serving bench failed: {e}")
+
+    # fleet serving (ISSUE 11, BENCH_r06+): N replicas behind the
+    # donner routing core — fleet throughput, p99/p99.9, durable-
+    # snapshot save/restore (re-warm) timings, and a graceful drain
+    # under load with zero failed requests
+    try:
+        if _within_budget():
+            record.update(bench_fleet_serving())
+            emit()
+    except Exception as e:
+        print(f"# fleet serving bench failed: {e}")
 
     # distributed worker fast path (ISSUE 5): 3-worker logreg batch-128
     # over local TCP — compiled per-role plans vs the legacy eager

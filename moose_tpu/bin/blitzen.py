@@ -7,22 +7,75 @@ the telemetry exporter: nothing to install in the serving image).
   POST /v1/models/<name>:predict   {"x": [[...], ...]}  ->  {"y": [...]}
   GET  /v1/metrics                 serving telemetry snapshot (JSON)
   GET  /metrics                    unified registry, Prometheus text
-  GET  /healthz                    {"status": "ok", "models": [...]}
+  GET  /healthz                    liveness: 200 while the process runs
+  GET  /readyz                     readiness: 200 only when serving;
+                                   503 {"status": "warming"|"draining"}
 
 Every model file is an ONNX graph imported through ``from_onnx`` (the
 same path the examples use); registration traces, compiles each batch
 bucket, and drives the validated-jit ladder to steady state BEFORE the
 socket opens, so the first request is as fast as the millionth.
 Backpressure surfaces as HTTP 429 (queue full) and 504 (deadline
-expired) with the typed error class in the JSON body.
+expired) with the typed error class and its ``retryable`` bit in the
+JSON body.
+
+Fleet mode (see DEVELOP.md "Fleet serving"):
+
+- ``--snapshot-dir`` / ``MOOSE_TPU_SNAPSHOT_DIR``: cold-start from the
+  durable warm-state snapshot when a valid one exists (seconds instead
+  of the full trace/compile/validate minutes), falling back to fresh
+  registration — after which the warm state is snapshotted for the
+  next restart.  The jax persistent compilation cache is pointed into
+  the same directory so bucket re-jits replay on-disk XLA binaries.
+- SIGTERM triggers a **zero-downtime drain**: readiness flips to 503
+  (the ``donner`` router stops routing here), new submissions answer
+  ``503 + Retry-After`` with a retryable body, in-flight batches
+  finish, the warm state is re-snapshotted, and the process exits.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
+import threading
 from pathlib import Path
+
+
+class ReplicaLifecycle:
+    """The replica's readiness state machine: ``warming`` -> ``ready``
+    -> ``draining`` -> ``stopped``.  ``/healthz`` is liveness (200 for
+    as long as the process answers); ``/readyz`` reflects THIS state,
+    and the router ejects on readiness, never on liveness — a warming
+    or draining replica is alive but must receive no traffic."""
+
+    def __init__(self):
+        self._state = "warming"
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def set_ready(self) -> None:
+        with self._lock:
+            if self._state == "warming":
+                self._state = "ready"
+
+    def start_drain(self) -> bool:
+        """Flip to draining; True only for the FIRST caller (signal
+        handlers can fire more than once)."""
+        with self._lock:
+            if self._state in ("draining", "stopped"):
+                return False
+            self._state = "draining"
+        return True
+
+    def stopped(self) -> None:
+        with self._lock:
+            self._state = "stopped"
 
 
 def parse_models(specs) -> dict:
@@ -38,7 +91,11 @@ def parse_models(specs) -> dict:
 
 def build_server(model_paths: dict, row_features: dict, args):
     """Construct + warm an InferenceServer (shared by serve and
-    --oneshot; tests call this directly)."""
+    --oneshot; tests call this directly).  With a snapshot directory
+    configured, tries the durable warm-state snapshot FIRST (validated
+    against the model files' digests) and only pays the full
+    trace/compile/validate cost when no valid snapshot exists — then
+    writes one for the next restart."""
     from moose_tpu import predictors
     from moose_tpu.serving import InferenceServer, ServingConfig
 
@@ -48,9 +105,63 @@ def build_server(model_paths: dict, row_features: dict, args):
         queue_bound=args.queue_bound,
         default_deadline_ms=args.deadline_ms,
     )
-    server = InferenceServer(config=config)
+    snapshot_dir = getattr(args, "snapshot_dir", None) or os.environ.get(
+        "MOOSE_TPU_SNAPSHOT_DIR"
+    )
+    source_digests = {}
+    raws = {}
     for name, path in model_paths.items():
         raw = Path(path).read_bytes()
+        raws[name] = raw
+        source_digests[name] = hashlib.blake2b(
+            raw
+            + repr(
+                (row_features.get(name), config.max_batch)
+            ).encode(),
+            digest_size=16,
+        ).hexdigest()
+
+    server = InferenceServer(config=config)
+    server.snapshot_report = None
+    server.source_digests = source_digests
+    if snapshot_dir:
+        from moose_tpu.errors import SnapshotError
+        from moose_tpu.serving import snapshot as snapshot_mod
+
+        # bucket re-jits replay on-disk XLA binaries on restart
+        snapshot_mod.enable_compilation_cache(snapshot_dir)
+        try:
+            server.snapshot_report = server.load_snapshot(
+                snapshot_dir, source_digests=source_digests
+            )
+            print(
+                "blitzen: restored warm state from "
+                f"{server.snapshot_report['snapshot']} in "
+                f"{server.snapshot_report['rewarm_s']:.2f}s "
+                f"({server.snapshot_report['probe_checked']} probe "
+                "digest(s) verified)",
+                flush=True,
+            )
+            _record_rewarm(server.snapshot_report["rewarm_s"])
+            return server
+        except SnapshotError as e:
+            print(
+                f"blitzen: snapshot unusable ({e}); registering fresh",
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001 — the snapshot contract
+            # is "fall back to fresh registration on ANY restore
+            # failure": an unexpected class (a rewarm evaluation
+            # blowing up on a changed jax backend that the manifest's
+            # package-version check cannot see) must not turn a
+            # persistent snapshot volume into a crash loop
+            print(
+                "blitzen: snapshot restore failed unexpectedly "
+                f"({type(e).__name__}: {e}); registering fresh",
+                flush=True,
+            )
+    for name, path in model_paths.items():
+        raw = raws[name]
         model = predictors.from_onnx(raw)
         n_features = row_features.get(name)
         if n_features is None:
@@ -97,32 +208,82 @@ def build_server(model_paths: dict, row_features: dict, args):
                 f"model {name!r} failed the static lint at "
                 f"registration: {e}"
             ) from e
+    if snapshot_dir:
+        # warm state is durable from here: the NEXT restart skips the
+        # registration cost this process just paid.  Best-effort — the
+        # registration SUCCEEDED, so a snapshot failure (disk full,
+        # permission) must not take the replica down with it
+        try:
+            server.save_snapshot(
+                snapshot_dir, source_digests=source_digests
+            )
+        except Exception as e:  # noqa: BLE001 — serve anyway
+            print(
+                f"blitzen: post-warmup snapshot failed: {e}",
+                flush=True,
+            )
     return server
 
 
-def _make_handler(server):
+def _record_rewarm(seconds: float) -> None:
+    from moose_tpu import metrics as metrics_mod
+
+    metrics_mod.gauge(
+        "moose_tpu_serving_rewarm_seconds",
+        "time to restore warm state from the snapshot at startup",
+    ).set(seconds)
+
+
+def _make_handler(server, lifecycle=None):
     from concurrent.futures import TimeoutError as FutureTimeoutError
     from http.server import BaseHTTPRequestHandler
 
     from moose_tpu.errors import (
         CompilationError,
         ConfigurationError,
+        ReplicaDrainingError,
         ServerOverloadedError,
+        is_retryable,
     )
+
+    lifecycle = lifecycle or ReplicaLifecycle()
+    if lifecycle.state == "warming" and server.registry.names():
+        # built via the in-process API (tests) where warmup already
+        # happened before the handler exists
+        lifecycle.set_ready()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: dict = None) -> None:
             self._reply_raw(
-                code, json.dumps(payload).encode(), "application/json"
+                code, json.dumps(payload).encode(), "application/json",
+                headers=headers,
+            )
+
+        def _reply_error(self, code: int, exc: BaseException,
+                         headers: dict = None) -> None:
+            # the typed error class plus its retryable bit: donner (and
+            # any other client) decides resubmit-vs-surface from the
+            # body alone, never by string-matching messages
+            self._reply(
+                code,
+                {
+                    "error": type(exc).__name__,
+                    "message": str(exc),
+                    "retryable": bool(is_retryable(exc)),
+                },
+                headers=headers,
             )
 
         def _reply_raw(self, code: int, body: bytes,
-                       content_type: str) -> None:
+                       content_type: str, headers: dict = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -132,9 +293,19 @@ def _make_handler(server):
 
         def do_GET(self):
             if self.path == "/healthz":
+                # liveness ONLY: stays 200 through warming and draining
+                # (kubelet-style restarts key off liveness; routing
+                # keys off readiness below)
                 self._reply(
                     200,
                     {"status": "ok", "models": server.registry.names()},
+                )
+            elif self.path == "/readyz":
+                state = lifecycle.state
+                self._reply(
+                    200 if state == "ready" else 503,
+                    {"status": state,
+                     "models": server.registry.names()},
                 )
             elif self.path == "/v1/metrics":
                 self._reply(200, server.metrics_snapshot())
@@ -183,40 +354,43 @@ def _make_handler(server):
                     raise ValueError(
                         f"deadline_ms must be a number, got {deadline_ms!r}"
                     )
+                if lifecycle.state != "ready":
+                    # admission is closed while warming/draining; the
+                    # Retry-After invites the caller (or donner) to
+                    # resubmit elsewhere / later — the typed body says
+                    # it is safe (the request was never evaluated)
+                    raise ReplicaDrainingError(
+                        f"replica is {lifecycle.state}; retry on "
+                        "another replica"
+                    )
                 y = server.predict(
                     name,
                     request["x"],
                     deadline_ms=deadline_ms,
                 )
                 self._reply(200, {"y": y.tolist()})
+            except ReplicaDrainingError as e:
+                self._reply_error(503, e, headers={"Retry-After": "1"})
             except ServerOverloadedError as e:
-                self._reply(
-                    429, {"error": type(e).__name__, "message": str(e)}
-                )
+                self._reply_error(429, e, headers={"Retry-After": "1"})
             except (TimeoutError, FutureTimeoutError) as e:
                 # DeadlineExceededError subclasses TimeoutError; the
                 # second class is Future.result's py3.10 timeout for a
                 # request stuck behind a deep queue — a handler must
                 # always answer, never drop the connection
-                self._reply(
-                    504, {"error": type(e).__name__, "message": str(e)}
-                )
+                self._reply_error(504, e)
             except (CompilationError, ConfigurationError, KeyError,
                     ValueError, json.JSONDecodeError) as e:
                 # CompilationError covers the registry's strict lint
                 # (MalformedComputationError with MSA diagnostics): a
                 # bad model is the CLIENT's fault — 4xx, not 500
-                self._reply(
-                    400, {"error": type(e).__name__, "message": str(e)}
-                )
+                self._reply_error(400, e)
             except Exception as e:  # noqa: BLE001 — an eval failure
                 # propagates the typed root cause through the request
                 # Future; answering 500 (instead of letting the
                 # handler abort and drop the keep-alive socket) keeps
                 # the always-answer contract for unforeseen classes too
-                self._reply(
-                    500, {"error": type(e).__name__, "message": str(e)}
-                )
+                self._reply_error(500, e)
 
     return Handler
 
@@ -249,6 +423,17 @@ def main(argv=None):
     parser.add_argument(
         "--deadline-ms", type=float, default=None,
         help="default per-request deadline (MOOSE_TPU_SERVE_DEADLINE_MS)",
+    )
+    parser.add_argument(
+        "--snapshot-dir", default=None, metavar="DIR",
+        help="durable warm-state snapshot directory "
+        "(MOOSE_TPU_SNAPSHOT_DIR): restore from it at startup when "
+        "valid, write to it after warmup and on graceful drain",
+    )
+    parser.add_argument(
+        "--drain-timeout-s", type=float, default=30.0,
+        help="bound on waiting for in-flight requests during a "
+        "SIGTERM drain",
     )
     parser.add_argument(
         "--oneshot", default=None, metavar="JSON",
@@ -284,26 +469,99 @@ def main(argv=None):
         server.close()
         return
 
+    import signal
+    import time
     from http.server import ThreadingHTTPServer
 
+    lifecycle = ReplicaLifecycle()
     httpd = ThreadingHTTPServer(
-        (args.host, args.port), _make_handler(server)
+        (args.host, args.port), _make_handler(server, lifecycle)
     )
+    # the registry is warm (restored or freshly registered) and the
+    # socket is bound: this replica may receive traffic
+    lifecycle.set_ready()
+    snapshot_dir = getattr(args, "snapshot_dir", None) or os.environ.get(
+        "MOOSE_TPU_SNAPSHOT_DIR"
+    )
+
+    def _drain_sequence():
+        # the drain state machine, run while the HTTP server KEEPS
+        # ANSWERING: /readyz already says 503 (the router stops
+        # routing here) and new predicts answer 503 + Retry-After with
+        # a retryable body; now finish every in-flight batch, persist
+        # the warm state, and only then stop accepting connections
+        t0 = time.perf_counter()
+        drained = server.drain(timeout_s=args.drain_timeout_s)
+        from moose_tpu import metrics as metrics_mod
+
+        metrics_mod.gauge(
+            "moose_tpu_serving_drain_seconds",
+            "duration of the most recent graceful drain",
+        ).set(time.perf_counter() - t0)
+        if snapshot_dir:
+            try:
+                server.save_snapshot(
+                    snapshot_dir,
+                    source_digests=getattr(
+                        server, "source_digests", None
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — a failed snapshot
+                # must not turn a clean drain into a crash loop; the
+                # next start falls back to fresh registration
+                print(
+                    f"blitzen: snapshot on drain failed: {e}",
+                    flush=True,
+                )
+        print(
+            "blitzen: drained "
+            f"({'clean' if drained else 'timed out'}) in "
+            f"{time.perf_counter() - t0:.2f}s; exiting",
+            flush=True,
+        )
+        httpd.shutdown()
+
+    def _on_drain_signal(signum, frame):
+        if lifecycle.start_drain():
+            # the drain itself runs OUTSIDE the handler: signal
+            # handlers must not join threads or write snapshots
+            threading.Thread(
+                target=_drain_sequence, name="drain", daemon=True
+            ).start()
+        if signum == signal.SIGINT:
+            # a second Ctrl-C force-exits instead of re-entering the
+            # (already running) drain
+            signal.signal(signal.SIGINT, signal.SIG_DFL)
+
+    signal.signal(signal.SIGTERM, _on_drain_signal)
+    # SIGINT drains the same way: serve_forever keeps ANSWERING
+    # (503 + Retry-After on predicts, 503 on /readyz) until the drain
+    # thread calls httpd.shutdown() — a raised KeyboardInterrupt would
+    # instead stop the accept loop BEFORE the drain, leaving probes and
+    # retries hanging in the listen backlog for the whole drain window
+    signal.signal(signal.SIGINT, _on_drain_signal)
+    # port 0 binds an ephemeral port — print the REAL one so fleet
+    # tooling (scripts/fleet_smoke.py) can discover it from stdout
     print(
         f"blitzen: serving {server.registry.names()} on "
-        f"http://{args.host}:{args.port} "
+        f"http://{args.host}:{httpd.server_port} "
         f"(max_batch={server.config.max_batch}, "
         f"max_wait_ms={server.config.max_wait_ms}, "
-        f"queue_bound={server.config.queue_bound})",
+        f"queue_bound={server.config.queue_bound}, "
+        f"snapshot_dir={snapshot_dir})",
         flush=True,
     )
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
-        pass
+        # only reachable if SIGINT was re-raised outside our handler
+        # (e.g. the SIG_DFL reset above): last-resort synchronous drain
+        if lifecycle.start_drain():
+            _drain_sequence()
     finally:
         httpd.server_close()
         server.close()
+        lifecycle.stopped()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,642 @@
+"""donner: the fleet front door — a shared-nothing HTTP router
+spreading requests over N blitzen replicas (stdlib-only, like blitzen:
+nothing to install in the serving image).
+
+  python -m moose_tpu.bin.donner \\
+      --replica http://127.0.0.1:9001 --replica http://127.0.0.1:9002 \\
+      --port 9000
+
+  POST /v1/models/<name>:predict   forwarded to a ready replica
+  GET  /metrics                    router metrics, Prometheus text
+  GET  /healthz                    router liveness
+  GET  /readyz                     200 iff >= 1 replica is ready
+  GET  /fleet                      per-replica routing state (JSON)
+
+Routing policy (see DEVELOP.md "Fleet serving"):
+
+- **health-based ejection on READINESS, not liveness**: a prober
+  thread polls every replica's ``/readyz``; after ``eject_after``
+  consecutive failures the replica is ejected from rotation (new
+  requests stop routing to it — its in-flight requests drain
+  naturally, finishing or failing onto another replica), and after
+  ``readmit_after`` consecutive successes it is readmitted;
+- **retryable failures move to a DIFFERENT replica**: connection
+  failures, per-attempt timeouts, and any HTTP response whose typed
+  JSON body carries ``retryable: true`` (blitzen's 503-draining /
+  429-overloaded / drained-queue answers) are resubmitted under capped
+  exponential backoff with jitter, rotating away from every replica
+  already tried this request; non-retryable answers (4xx model errors,
+  504 deadline) pass through untouched;
+- **per-tenant token-bucket admission** ahead of the replica queues:
+  the ``X-Moose-Tenant`` header names the bucket (``default``
+  otherwise); an empty bucket answers a typed retryable 429 without
+  consuming replica capacity — this layers ON TOP of blitzen's own
+  typed 429/504 backpressure, it does not replace it.
+
+A request is "dropped" only if every routing attempt is exhausted with
+no ready replica to try — the fleet smoke asserts this never happens
+across a replica kill + rolling restart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import ConfigurationError
+from ..serving.config import _env_number
+
+
+class FleetConfig:
+    """Router knobs (env-overridable via ``MOOSE_TPU_FLEET_*``, flag-
+    overridable in the CLI)."""
+
+    def __init__(self, **overrides):
+        env = {
+            "probe_interval_ms": _env_number(
+                "MOOSE_TPU_FLEET_PROBE_MS", 500.0, float
+            ),
+            "eject_after": _env_number(
+                "MOOSE_TPU_FLEET_EJECT_AFTER", 2, int
+            ),
+            "readmit_after": _env_number(
+                "MOOSE_TPU_FLEET_READMIT_AFTER", 2, int
+            ),
+            "max_attempts": _env_number(
+                "MOOSE_TPU_FLEET_RETRIES", 4, int
+            ),
+            "backoff_ms": _env_number(
+                "MOOSE_TPU_FLEET_BACKOFF_MS", 25.0, float
+            ),
+            "backoff_cap_ms": _env_number(
+                "MOOSE_TPU_FLEET_BACKOFF_CAP_MS", 1000.0, float
+            ),
+            "attempt_timeout_s": _env_number(
+                "MOOSE_TPU_FLEET_TIMEOUT_S", 120.0, float
+            ),
+            "tenant_rate": _env_number(
+                "MOOSE_TPU_FLEET_TENANT_RATE", 0.0, float
+            ),
+            "tenant_burst": _env_number(
+                "MOOSE_TPU_FLEET_TENANT_BURST", 0.0, float
+            ),
+        }
+        env.update({k: v for k, v in overrides.items() if v is not None})
+        unknown = set(env) - {
+            "probe_interval_ms", "eject_after", "readmit_after",
+            "max_attempts", "backoff_ms", "backoff_cap_ms",
+            "attempt_timeout_s", "tenant_rate", "tenant_burst",
+        }
+        if unknown:
+            raise ConfigurationError(f"unknown fleet knobs: {unknown}")
+        for key, value in env.items():
+            setattr(self, key, value)
+        if self.max_attempts < 1:
+            raise ConfigurationError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.eject_after < 1 or self.readmit_after < 1:
+            raise ConfigurationError(
+                "eject_after/readmit_after must be >= 1"
+            )
+
+
+class TokenBucket:
+    """Per-tenant admission: ``rate`` tokens/s up to ``burst``.  A rate
+    of 0 disables the bucket (every take succeeds)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst else max(1.0, self.rate)
+        self._tokens = self.burst
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rate
+            )
+            self._stamp = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class Replica:
+    """One blitzen backend: its routing state plus the in-flight count
+    the drain logic reads."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self.ready = False  # until the first successful readiness probe
+        self.ejected = False
+        self.consecutive_failures = 0
+        self.consecutive_successes = 0
+        self.in_flight = 0
+        self.last_status = "unprobed"
+        self._lock = threading.Lock()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "url": self.base_url,
+                "ready": self.ready,
+                "ejected": self.ejected,
+                "in_flight": self.in_flight,
+                "last_status": self.last_status,
+            }
+
+
+class RouterMetrics:
+    def __init__(self):
+        from .. import metrics
+
+        self.requests = metrics.counter(
+            "moose_tpu_donner_requests_total",
+            "requests answered by the router", labels=("outcome",),
+        )
+        self.retries = metrics.counter(
+            "moose_tpu_donner_retries_total",
+            "retryable failures resubmitted to another replica",
+            labels=("reason",),
+        )
+        self.ejections = metrics.counter(
+            "moose_tpu_donner_ejections_total",
+            "replicas ejected on readiness failure",
+        )
+        self.readmissions = metrics.counter(
+            "moose_tpu_donner_readmissions_total",
+            "ejected replicas readmitted after readiness recovery",
+        )
+        self.tenant_rejections = metrics.counter(
+            "moose_tpu_donner_tenant_rejections_total",
+            "requests rejected by per-tenant token-bucket admission",
+            labels=("tenant",),
+        )
+        self.ready_gauge = metrics.gauge(
+            "moose_tpu_donner_ready_replicas",
+            "replicas currently in rotation",
+        )
+        self.inflight_gauge = metrics.gauge(
+            "moose_tpu_donner_in_flight",
+            "requests currently forwarded, per replica",
+            ("replica",),
+        )
+
+
+class Router:
+    """The routing core, independent of the HTTP front end (tests drive
+    it directly): readiness probing + ejection, replica choice, typed
+    retry, tenant admission."""
+
+    def __init__(self, replica_urls: List[str],
+                 config: Optional[FleetConfig] = None):
+        if not replica_urls:
+            raise ConfigurationError("donner needs at least one --replica")
+        self.config = config or FleetConfig()
+        self.replicas = [Replica(u) for u in replica_urls]
+        self.metrics = RouterMetrics()
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._stop = threading.Event()
+        self._prober = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="donner-prober", daemon=True
+        )
+        self._prober.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5)
+
+    # -- health ------------------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            for replica in self.replicas:
+                self.probe_once(replica)
+            self.metrics.ready_gauge.set(len(self.ready_replicas()))
+            self._stop.wait(self.config.probe_interval_ms / 1e3)
+
+    def probe_once(self, replica: Replica) -> bool:
+        """One readiness probe; applies the ejection/readmission state
+        machine.  Liveness (`/healthz`) is deliberately NOT consulted:
+        a draining replica is alive but must stop receiving traffic,
+        so rotation keys off readiness alone."""
+        try:
+            with urllib.request.urlopen(
+                replica.base_url + "/readyz", timeout=5
+            ) as resp:
+                ok = resp.status == 200
+                status = f"http-{resp.status}"
+        except Exception as e:  # noqa: BLE001 — any probe failure is
+            # just "not ready" (connection refused, timeout, 503, ...)
+            ok = False
+            status = (
+                f"http-{e.code}"
+                if isinstance(e, urllib.error.HTTPError)
+                else type(e).__name__
+            )
+        with replica._lock:
+            replica.last_status = status
+            if ok:
+                replica.consecutive_failures = 0
+                replica.consecutive_successes += 1
+                replica.ready = True
+                if (
+                    replica.ejected
+                    and replica.consecutive_successes
+                    >= self.config.readmit_after
+                ):
+                    replica.ejected = False
+                    self.metrics.readmissions.inc()
+            else:
+                replica.consecutive_successes = 0
+                replica.consecutive_failures += 1
+                if (
+                    not replica.ejected
+                    and replica.consecutive_failures
+                    >= self.config.eject_after
+                ):
+                    # ejection = connection draining: no NEW requests
+                    # route here; forwards already in flight finish (or
+                    # fail retryably and move on) on their own
+                    replica.ejected = True
+                    self.metrics.ejections.inc()
+                if replica.ejected:
+                    # the eject_after hysteresis applies to ROTATION,
+                    # not just the counters: a single probe blip (GC
+                    # pause, dropped packet) must not yank a healthy
+                    # replica out of rotation — ready only drops once
+                    # the failure streak actually ejects it
+                    replica.ready = False
+        return ok
+
+    def ready_replicas(self) -> List[Replica]:
+        return [
+            r for r in self.replicas
+            if r.ready and not r.ejected
+        ]
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, tenant: str) -> bool:
+        config = self.config
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(
+                    config.tenant_rate, config.tenant_burst
+                )
+        if bucket.take():
+            return True
+        self.metrics.tenant_rejections.inc(tenant=tenant)
+        return False
+
+    # -- routing -----------------------------------------------------------
+
+    def choose(self, exclude) -> Optional[Replica]:
+        """Round-robin over ready replicas, skipping ``exclude`` (the
+        ones this request already failed on).  Falls back to an
+        excluded-but-ready replica only when nothing else is left —
+        retrying the same replica beats dropping the request."""
+        ready = self.ready_replicas()
+        fresh = [r for r in ready if r.base_url not in exclude]
+        pool = fresh or ready
+        if not pool:
+            return None
+        with self._lock:
+            self._rr += 1
+            return pool[self._rr % len(pool)]
+
+    def forward(self, path: str, body: bytes,
+                headers: Dict[str, str]) -> Tuple[int, bytes, dict]:
+        """Route one request: returns (status, body, info).  Retryable
+        failures rotate to a different replica under capped
+        exponential backoff; after ``max_attempts`` the LAST typed
+        answer (or a 503 when no replica ever answered) surfaces."""
+        config = self.config
+        tried = set()
+        last: Optional[Tuple[int, bytes]] = None
+        attempts = 0
+        for attempt in range(config.max_attempts):
+            replica = self.choose(exclude=tried)
+            if replica is None:
+                # a transiently empty rotation (rolling restart: the
+                # last old replica ejected a probe cycle before the
+                # new one is readmitted) is worth waiting out — back
+                # off and re-choose instead of dropping the request;
+                # prefer the last real typed answer when one exists
+                last = last or (
+                    503,
+                    _typed_body(
+                        "ServerOverloadedError",
+                        "no ready replica in the fleet; back off "
+                        "and retry",
+                        retryable=True,
+                    ),
+                )
+                if attempt + 1 < config.max_attempts:
+                    backoff = min(
+                        config.backoff_cap_ms,
+                        config.backoff_ms * (2 ** attempt),
+                    ) / 1e3
+                    time.sleep(backoff * (0.5 + random.random() / 2))
+                continue
+            attempts += 1
+            tried.add(replica.base_url)
+            with replica._lock:
+                replica.in_flight += 1
+            self.metrics.inflight_gauge.set(
+                replica.in_flight, replica=replica.base_url
+            )
+            try:
+                status, payload = self._attempt(
+                    replica, path, body, headers
+                )
+            finally:
+                with replica._lock:
+                    replica.in_flight -= 1
+                self.metrics.inflight_gauge.set(
+                    replica.in_flight, replica=replica.base_url
+                )
+            if status is None:
+                # connection-level failure (refused, reset, timeout):
+                # retryable by definition — the replica never answered,
+                # and predict is a pure function of its inputs, so
+                # resubmitting cannot double-apply anything
+                self.metrics.retries.inc(reason=payload.decode())
+                last = (
+                    503,
+                    _typed_body(
+                        "PeerUnreachableError",
+                        f"replica {replica.base_url} unreachable "
+                        f"({payload.decode()})",
+                        retryable=True,
+                    ),
+                )
+            elif status < 500 and status != 429:
+                # success or a non-retryable client-side answer: pass
+                # through untouched (bodies already carry typed errors)
+                self._count(status)
+                return status, payload, {
+                    "replica": replica.base_url,
+                    "attempts": attempts,
+                }
+            else:
+                last = (status, payload)
+                if not _body_retryable(payload):
+                    self._count(status)
+                    return status, payload, {
+                        "replica": replica.base_url,
+                        "attempts": attempts,
+                    }
+                self.metrics.retries.inc(reason=f"http-{status}")
+            if attempt + 1 < config.max_attempts:
+                backoff = min(
+                    config.backoff_cap_ms,
+                    config.backoff_ms * (2 ** attempt),
+                ) / 1e3
+                time.sleep(backoff * (0.5 + random.random() / 2))
+        if last is None:
+            last = (
+                503,
+                _typed_body(
+                    "ServerOverloadedError",
+                    "no ready replica in the fleet; back off and retry",
+                    retryable=True,
+                ),
+            )
+        self._count(last[0])
+        return last[0], last[1], {"replica": None, "attempts": attempts}
+
+    def _attempt(self, replica: Replica, path: str, body: bytes,
+                 headers: Dict[str, str]):
+        """One forward: (status, body) — status None means a
+        connection-level failure, body then carries the reason tag."""
+        request = urllib.request.Request(
+            replica.base_url + path,
+            data=body,
+            headers={
+                "Content-Type": headers.get(
+                    "Content-Type", "application/json"
+                ),
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                request, timeout=self.config.attempt_timeout_s
+            ) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except Exception as e:  # noqa: BLE001 — connection-level only:
+            # refused/reset/timeout/DNS; HTTP answers took the branch
+            # above
+            return None, type(e).__name__.encode()
+
+    def _count(self, status: int) -> None:
+        bucket = f"{status // 100}xx"
+        self.metrics.requests.inc(outcome=bucket)
+
+    def fleet_snapshot(self) -> dict:
+        return {
+            "replicas": [r.snapshot() for r in self.replicas],
+            "ready": len(self.ready_replicas()),
+        }
+
+
+def _typed_body(cls: str, message: str, retryable: bool) -> bytes:
+    return json.dumps({
+        "error": cls, "message": message, "retryable": retryable,
+    }).encode()
+
+
+def _body_retryable(payload: bytes) -> bool:
+    """The typed wire contract: trust the replica's own retryable bit
+    (errors.to_wire discipline) — never string-match messages.  A body
+    that is not typed JSON (proxy in the middle, crash garbage) is
+    treated as retryable only for 5xx, which is the only way this
+    function is reached."""
+    try:
+        return bool(json.loads(payload.decode()).get("retryable"))
+    except (ValueError, UnicodeDecodeError):
+        return True
+
+
+def _make_handler(router: Router):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, body: bytes,
+                   content_type: str = "application/json",
+                   headers: dict = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *log_args):  # quiet by default
+            if os.environ.get("MOOSE_TPU_TRACE", "0") not in ("0", ""):
+                super().log_message(fmt, *log_args)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, b'{"status": "ok"}')
+            elif self.path == "/readyz":
+                ready = len(router.ready_replicas())
+                self._reply(
+                    200 if ready else 503,
+                    json.dumps({
+                        "status": "ready" if ready else "no-replicas",
+                        "ready_replicas": ready,
+                    }).encode(),
+                )
+            elif self.path == "/fleet":
+                self._reply(
+                    200, json.dumps(router.fleet_snapshot()).encode()
+                )
+            elif self.path == "/metrics":
+                from moose_tpu import metrics as metrics_mod
+
+                self._reply(
+                    200,
+                    metrics_mod.render_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._reply(
+                    404,
+                    _typed_body("NotFound", self.path, retryable=False),
+                )
+
+        def do_POST(self):
+            if not self.path.startswith("/v1/models/"):
+                self._reply(
+                    404,
+                    _typed_body("NotFound", self.path, retryable=False),
+                )
+                return
+            tenant = self.headers.get("X-Moose-Tenant", "default")
+            if not router.admit(tenant):
+                self._reply(
+                    429,
+                    _typed_body(
+                        "ServerOverloadedError",
+                        f"tenant {tenant!r} exceeded its admission "
+                        "rate; back off and retry",
+                        retryable=True,
+                    ),
+                    headers={"Retry-After": "1"},
+                )
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length) if length else b"{}"
+            status, payload, _ = router.forward(
+                self.path, body, dict(self.headers)
+            )
+            headers = (
+                {"Retry-After": "1"} if status in (429, 503) else None
+            )
+            self._reply(status, payload, headers=headers)
+
+    return Handler
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="donner", description=__doc__)
+    parser.add_argument(
+        "--replica", action="append", default=[], metavar="URL",
+        help="blitzen base URL (repeatable): http://host:port",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument(
+        "--probe-interval-ms", type=float, default=None,
+        help="readiness probe period (MOOSE_TPU_FLEET_PROBE_MS)",
+    )
+    parser.add_argument(
+        "--eject-after", type=int, default=None,
+        help="consecutive readiness failures before ejection "
+        "(MOOSE_TPU_FLEET_EJECT_AFTER)",
+    )
+    parser.add_argument(
+        "--readmit-after", type=int, default=None,
+        help="consecutive readiness successes before readmission "
+        "(MOOSE_TPU_FLEET_READMIT_AFTER)",
+    )
+    parser.add_argument(
+        "--retries", type=int, default=None,
+        help="max routing attempts per request "
+        "(MOOSE_TPU_FLEET_RETRIES)",
+    )
+    parser.add_argument(
+        "--tenant-rate", type=float, default=None,
+        help="per-tenant admitted requests/second, 0 = unlimited "
+        "(MOOSE_TPU_FLEET_TENANT_RATE)",
+    )
+    parser.add_argument(
+        "--tenant-burst", type=float, default=None,
+        help="per-tenant burst capacity (MOOSE_TPU_FLEET_TENANT_BURST)",
+    )
+    args = parser.parse_args(argv)
+
+    config = FleetConfig(
+        probe_interval_ms=args.probe_interval_ms,
+        eject_after=args.eject_after,
+        readmit_after=args.readmit_after,
+        max_attempts=args.retries,
+        tenant_rate=args.tenant_rate,
+        tenant_burst=args.tenant_burst,
+    )
+    router = Router(args.replica, config=config)
+    router.start()
+
+    from http.server import ThreadingHTTPServer
+
+    httpd = ThreadingHTTPServer(
+        (args.host, args.port), _make_handler(router)
+    )
+    print(
+        f"donner: routing over {len(router.replicas)} replica(s) on "
+        f"http://{args.host}:{httpd.server_port} "
+        f"(eject_after={config.eject_after}, "
+        f"retries={config.max_attempts}, "
+        f"tenant_rate={config.tenant_rate})",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        router.stop()
+
+
+if __name__ == "__main__":
+    main()

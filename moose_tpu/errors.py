@@ -103,6 +103,26 @@ class ConfigurationError(MooseError, ValueError):
     """Invalid runtime/session configuration."""
 
 
+class ReplicaDrainingError(MooseError):
+    """The serving replica is draining (graceful shutdown in progress)
+    or shut down before the request was served: admission is closed and
+    queued requests are completed with this error instead of being
+    evaluated.  RETRYABLE by the taxonomy — the request was never
+    executed, so resubmitting it to ANOTHER replica (the ``donner``
+    router does this automatically) succeeds without double-evaluation
+    risk.  Surfaces over HTTP as ``503`` with a ``Retry-After``
+    header."""
+
+
+class SnapshotError(MooseError):
+    """A warm-state snapshot could not be written, or an on-disk
+    snapshot failed validation at load time (format-version skew,
+    checksum mismatch, model-set mismatch, or a bit-exactness probe
+    divergence under ``MOOSE_TPU_FIXED_KEYS``).  Loaders treat this as
+    "no snapshot": the replica falls back to a fresh registration
+    instead of serving from suspect state."""
+
+
 class ServerOverloadedError(MooseError):
     """The serving layer's bounded request queue is full (admission
     control, ``moose_tpu/serving``): the request was REJECTED, not
@@ -147,7 +167,13 @@ def is_retryable(exc: BaseException) -> bool:
     if isinstance(exc, _PERMANENT_NETWORKING):
         return False
     return isinstance(
-        exc, (NetworkingError, SessionAbortedError, ServerOverloadedError)
+        exc,
+        (
+            NetworkingError,
+            SessionAbortedError,
+            ServerOverloadedError,
+            ReplicaDrainingError,
+        ),
     )
 
 

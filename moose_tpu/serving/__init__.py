@@ -19,11 +19,18 @@ closes that gap for serving traffic:
   ``blitzen`` CLI daemon wraps it with an HTTP front end);
 - :mod:`metrics` — queue depth, batch-size histogram, batch-fill ratio,
   p50/p99 request latency, deadline misses, plus the warm-path
-  acceptance counters (no re-trace / no ladder re-run after warmup).
+  acceptance counters (no re-trace / no ladder re-run after warmup);
+- :mod:`snapshot` — durable warm-state snapshots (traced computations,
+  resolved plan states, lowered graphs, kernel verdicts, AOT bucket
+  artifacts, fixed-keys probe digests) so a replica cold-starts warm
+  in seconds; the fleet layer above this package is ``bin/blitzen``
+  (graceful drain, ``/readyz``) + ``bin/donner`` (the routing front
+  door) — DEVELOP.md "Fleet serving".
 
 Knobs: ``MOOSE_TPU_SERVE_MAX_BATCH`` / ``MOOSE_TPU_SERVE_MAX_WAIT_MS``
 / ``MOOSE_TPU_SERVE_QUEUE`` / ``MOOSE_TPU_SERVE_DEADLINE_MS`` (see
-:mod:`config`).
+:mod:`config`), ``MOOSE_TPU_SNAPSHOT_DIR`` / ``MOOSE_TPU_SNAPSHOT_AOT``
+(see :mod:`snapshot`).
 """
 
 from .config import ServingConfig
@@ -36,6 +43,11 @@ from .registry import (
 )
 from .batcher import ModelQueue
 from .server import InferenceServer
+from .snapshot import (
+    current_snapshot_path,
+    restore_registry,
+    save_snapshot,
+)
 
 __all__ = [
     "InferenceServer",
@@ -45,5 +57,8 @@ __all__ = [
     "ServingConfig",
     "ServingMetrics",
     "bucket_for",
+    "current_snapshot_path",
     "power_of_two_buckets",
+    "restore_registry",
+    "save_snapshot",
 ]

@@ -36,6 +36,7 @@ from .. import telemetry
 from ..errors import (
     ConfigurationError,
     DeadlineExceededError,
+    ReplicaDrainingError,
     ServerOverloadedError,
 )
 from .config import ServingConfig
@@ -68,6 +69,11 @@ class ModelQueue:
     def __post_init__(self):
         self._cv = threading.Condition()
         self._closed = False
+        self._draining = False
+        # batches popped from _pending but not yet fully dispatched:
+        # drain() must wait on BOTH (a request leaves _pending before
+        # its evaluation runs)
+        self._in_flight = 0
         # the scheduler thread inherits the registration-time trace
         # context (if any): its serve_batch roots stitch under the
         # server's trace instead of starting orphan roots per batch
@@ -122,9 +128,15 @@ class ModelQueue:
             ),
         )
         with self._cv:
-            if self._closed:
-                raise ConfigurationError(
-                    f"model queue {self.model.name!r} is shut down"
+            if self._closed or self._draining:
+                # RETRYABLE: the request was never evaluated, so the
+                # router can safely resubmit it to another replica (a
+                # non-retryable error here would fail the caller for a
+                # purely operational event — a rolling restart)
+                raise ReplicaDrainingError(
+                    f"model queue {self.model.name!r} is "
+                    f"{'shut down' if self._closed else 'draining'}; "
+                    "retry on another replica"
                 )
             if len(self._pending) >= self.config.queue_bound:
                 self.metrics.record_overload()
@@ -142,6 +154,26 @@ class ModelQueue:
         with self._cv:
             return len(self._pending)
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: close admission (new submissions raise
+        retryable :class:`ReplicaDrainingError`) but keep the scheduler
+        dispatching until every already-admitted request completes —
+        including the batch the scheduler already popped but has not
+        finished evaluating — up to ``timeout_s``.  Returns True when
+        everything finished in time.  Call :meth:`close` afterwards to
+        stop the scheduler thread (any leftovers then complete with the
+        same retryable error)."""
+        deadline = time.perf_counter() + timeout_s
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            while self._pending or self._in_flight:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=min(remaining, 0.05))
+        return True
+
     def close(self, timeout_s: float = 10.0) -> None:
         with self._cv:
             self._closed = True
@@ -152,18 +184,25 @@ class ModelQueue:
             leftovers = list(self._pending)
             self._pending.clear()
             self._pending_rows = 0
+        drained = 0
         for request in leftovers:
             # claim first: a caller-cancelled future rejects
             # set_exception with InvalidStateError, which would abort
             # this drain loop and strand the remaining leftovers
             if not request.future.set_running_or_notify_cancel():
                 continue
+            # retryable by design: these requests were never evaluated,
+            # so the fleet router resubmits them to another replica
+            # instead of surfacing a failure to the caller
             request.future.set_exception(
-                ConfigurationError(
+                ReplicaDrainingError(
                     f"model queue {self.model.name!r} shut down before "
-                    "the request was served"
+                    "the request was served; retry on another replica"
                 )
             )
+            drained += 1
+        if drained:
+            self.metrics.record_drained(drained)
 
     # -- scheduler side ----------------------------------------------------
 
@@ -185,6 +224,10 @@ class ModelQueue:
                             request.future.set_exception(e)
                         except Exception:  # noqa: BLE001 — already done
                             pass
+            finally:
+                with self._cv:
+                    self._in_flight -= 1
+                    self._cv.notify_all()
 
     def _gather(self):
         """Block for the first pending request, then hold the batch open
@@ -199,7 +242,13 @@ class ModelQueue:
                 self._cv.wait()
             opened_s = time.perf_counter()
             deadline_s = opened_s + self.config.max_wait_ms / 1e3
-            while self._pending_rows < max_rows and not self._closed:
+            # draining: no new requests can arrive, so holding the
+            # batch open for stragglers only delays the shutdown
+            while (
+                self._pending_rows < max_rows
+                and not self._closed
+                and not self._draining
+            ):
                 remaining = deadline_s - time.perf_counter()
                 if remaining <= 0:
                     break
@@ -214,6 +263,10 @@ class ModelQueue:
                 self._pending_rows -= nxt.rows.shape[0]
                 rows += nxt.rows.shape[0]
                 batch.append(nxt)
+            if batch:
+                # counted while still under the lock: drain() must see
+                # (pending empty AND nothing mid-dispatch) atomically
+                self._in_flight += 1
             return batch
 
     def _dispatch(self, batch) -> None:

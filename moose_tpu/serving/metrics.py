@@ -63,6 +63,23 @@ def _registry_metrics():
             "moose_tpu_serving_request_latency_seconds",
             "request latency from submit to scatter",
         ),
+        # the warm-registry acceptance counters, scrapeable: the fleet
+        # smoke asserts a snapshot-restored replica holds both at 0
+        # from its /metrics endpoint alone (no in-process access)
+        "retraces_after_warm": metrics.counter(
+            "moose_tpu_serving_retraces_after_warm_total",
+            "serving batches that re-entered the tracer after warmup",
+        ),
+        "validating_after_warm": metrics.counter(
+            "moose_tpu_serving_validating_after_warm_total",
+            "serving batches that landed on a validating (ladder) "
+            "evaluation after warmup",
+        ),
+        "drained": metrics.counter(
+            "moose_tpu_serving_drained_requests_total",
+            "queued requests completed with retryable "
+            "ReplicaDrainingError during shutdown",
+        ),
     }
 
 
@@ -84,6 +101,7 @@ class ServingMetrics:
         self.deadline_drops = 0  # expired before dispatch, never batched
         self.overloads = 0  # submissions rejected by admission control
         self.eval_failures = 0
+        self.drained_requests = 0  # completed with ReplicaDrainingError
         # acceptance counters: both must stay 0 after registration
         self.retraces_after_warm = 0
         self.validating_after_warm = 0
@@ -105,6 +123,10 @@ class ServingMetrics:
                 self.validating_after_warm += 1
         self._registry["batches"].inc()
         self._registry["rows"].inc(rows)
+        if retraced:
+            self._registry["retraces_after_warm"].inc()
+        if validating:
+            self._registry["validating_after_warm"].inc()
 
     def record_latency(self, seconds: float, missed_deadline: bool) -> None:
         with self._lock:
@@ -129,6 +151,11 @@ class ServingMetrics:
         with self._lock:
             self.eval_failures += 1
         self._registry["eval_failures"].inc()
+
+    def record_drained(self, count: int = 1) -> None:
+        with self._lock:
+            self.drained_requests += count
+        self._registry["drained"].inc(count)
 
     def reset_window(self) -> None:
         """Zero the traffic aggregates (batches, fill, histogram,
@@ -166,6 +193,7 @@ class ServingMetrics:
                 "deadline_drops": self.deadline_drops,
                 "overloads": self.overloads,
                 "eval_failures": self.eval_failures,
+                "drained_requests": self.drained_requests,
                 "retraces_after_warm": self.retraces_after_warm,
                 "validating_after_warm": self.validating_after_warm,
             }

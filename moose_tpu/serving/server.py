@@ -42,6 +42,7 @@ class InferenceServer:
         self.metrics = ServingMetrics()
         self._queues: Dict[str, ModelQueue] = {}
         self._closed = False
+        self._draining = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -73,6 +74,61 @@ class InferenceServer:
             metrics=self.metrics,
         )
         return registered
+
+    def load_snapshot(self, directory, source_digests=None,
+                      rewarm: bool = True) -> dict:
+        """Restore every model from the live warm-state snapshot under
+        ``directory`` (see :mod:`.snapshot`) and start a micro-batch
+        scheduler per restored model.  Raises
+        :class:`~moose_tpu.errors.SnapshotError` on any validation
+        failure, leaving the server empty (callers fall back to fresh
+        ``register_model`` calls)."""
+        from . import snapshot as snapshot_mod
+
+        if self._closed:
+            raise ConfigurationError("server is shut down")
+        report = snapshot_mod.restore_registry(
+            self.registry, directory,
+            source_digests=source_digests, rewarm=rewarm,
+        )
+        for name in report["models"]:
+            self._queues[name] = ModelQueue(
+                model=self.registry.get(name),
+                registry=self.registry,
+                config=self.config,
+                metrics=self.metrics,
+            )
+        return report
+
+    def save_snapshot(self, directory, source_digests=None):
+        """Persist the warm registry (see :mod:`.snapshot`); returns the
+        new snapshot path."""
+        from . import snapshot as snapshot_mod
+
+        return snapshot_mod.save_snapshot(
+            self, directory, source_digests=source_digests
+        )
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase one: stop admission on every model
+        queue (submissions raise retryable ``ReplicaDrainingError``) and
+        wait for all in-flight requests to finish, bounded by
+        ``timeout_s`` total.  Returns True when every queue emptied in
+        time.  The server stays alive for metrics scrapes; call
+        :meth:`close` to stop the scheduler threads afterwards."""
+        import time
+
+        self._draining = True
+        deadline = time.perf_counter() + timeout_s
+        drained = True
+        for queue in self._queues.values():
+            remaining = max(0.0, deadline - time.perf_counter())
+            drained = queue.drain(timeout_s=remaining) and drained
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def close(self) -> None:
         self._closed = True

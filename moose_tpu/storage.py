@@ -9,8 +9,11 @@ numpy.rs, csv.rs).
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import json
+import os
+import tempfile
 from pathlib import Path
 
 import numpy as np
@@ -65,7 +68,26 @@ class FilesystemStorage:
             raise StorageError(
                 f"cannot persist object-dtype array under key {key!r}"
             )
-        np.save(self._path(key, ".npy"), arr, allow_pickle=False)
+        # write-then-rename: a crash mid-write must never leave a
+        # truncated .npy at the key's path (it would poison every later
+        # load).  The temp file lives in the SAME directory so
+        # os.replace stays an atomic same-filesystem rename.
+        target = self._path(key, ".npy")
+        tmp = tempfile.NamedTemporaryFile(
+            dir=self.root, prefix=target.name + ".", suffix=".tmp",
+            delete=False,
+        )
+        try:
+            np.save(tmp, arr, allow_pickle=False)
+            tmp.flush()
+            os.fsync(tmp.fileno())
+            tmp.close()
+            os.replace(tmp.name, target)
+        except BaseException:
+            tmp.close()
+            with contextlib.suppress(OSError):
+                os.unlink(tmp.name)
+            raise
 
     def _load_csv(self, path: Path, query: str):
         """Load a csv as float64 columns; ``query`` is the reference's

@@ -1,0 +1,72 @@
+"""FilesystemStorage: atomic save semantics (ISSUE 11 satellite).
+
+A crash mid-``save`` must never leave a truncated ``.npy`` at the key's
+path: the write goes to a same-directory temp file and lands via
+``os.replace`` (atomic on POSIX), so a reader sees either the old value
+or the new one — never garbage that poisons the next load.
+"""
+
+import numpy as np
+import pytest
+
+from moose_tpu.errors import StorageError
+from moose_tpu.storage import FilesystemStorage
+
+
+def test_save_load_roundtrip(tmp_path):
+    storage = FilesystemStorage(str(tmp_path))
+    value = np.arange(12, dtype=np.float64).reshape(3, 4)
+    storage.save("model.v1", value)
+    np.testing.assert_array_equal(storage.load("model.v1"), value)
+
+
+def test_crash_mid_save_keeps_previous_value(tmp_path, monkeypatch):
+    storage = FilesystemStorage(str(tmp_path))
+    old = np.arange(6, dtype=np.float64)
+    storage.save("weights", old)
+
+    real_save = np.save
+
+    def exploding_save(file, arr, **kwargs):
+        # simulate a crash mid-write: SOME bytes land in the target
+        # stream, then the process "dies"
+        file.write(b"\x93NUMPY-truncated")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "save", exploding_save)
+    with pytest.raises(OSError):
+        storage.save("weights", np.zeros(1000))
+    monkeypatch.setattr(np, "save", real_save)
+
+    # the key still loads the OLD value bit-for-bit: the torn write
+    # never reached weights.npy
+    np.testing.assert_array_equal(storage.load("weights"), old)
+    # and the temp file was cleaned up — no .tmp litter accumulates
+    # across crash loops
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_crash_mid_save_of_new_key_leaves_no_file(tmp_path, monkeypatch):
+    storage = FilesystemStorage(str(tmp_path))
+
+    def exploding_save(file, arr, **kwargs):
+        file.write(b"partial")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "save", exploding_save)
+    with pytest.raises(OSError):
+        storage.save("fresh", np.ones(4))
+
+    # a never-successfully-saved key must not exist at all (a truncated
+    # file would make `key in storage` True and poison load)
+    assert "fresh" not in storage
+    with pytest.raises(StorageError):
+        storage.load("fresh")
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_object_dtype_still_rejected_before_any_write(tmp_path):
+    storage = FilesystemStorage(str(tmp_path))
+    with pytest.raises(StorageError):
+        storage.save("bad", np.array([object()]))
+    assert not list(tmp_path.iterdir())
