@@ -763,7 +763,14 @@ def bench_fleet_serving(replicas=3, clients=48, requests_per_client=6,
     (save, per-replica restore/re-warm — the "cold-start warm in
     seconds" claim), and the graceful-drain duration of one replica
     under load with ZERO failed requests (the router resolves every
-    retryable 503 on the surviving replicas)."""
+    retryable 503 on the surviving replicas).
+
+    Flight evidence (ROADMAP item 2d / ISSUE 12 satellite): every
+    replica's flight-recorder events for the benched window (replica
+    lifecycle transitions, serving drains, ...) are captured into the
+    record as ``fleet_flight`` — counts by kind and replica plus the
+    event tail — so a BENCH round carries the behavioural trace of the
+    fleet it measured, not just its numbers."""
     import threading
     from http.server import ThreadingHTTPServer
 
@@ -790,8 +797,14 @@ def bench_fleet_serving(replicas=3, clients=48, requests_per_client=6,
 
     import tempfile
 
+    from moose_tpu import flight
+
     snapdir = tempfile.mkdtemp(prefix="bench_fleet_snap_")
     servers, httpds, lifecycles = [], [], []
+    # the benched window opens HERE: every flight event from replica
+    # construction through the drain (monotonic clock, so ordering is
+    # skew-free) lands in the record's fleet_flight evidence
+    flight_window_start = time.monotonic()
     try:
         # replica 0 registers fresh and writes the durable snapshot;
         # the rest cold-start FROM it (the fleet story: one replica
@@ -818,8 +831,8 @@ def bench_fleet_serving(replicas=3, clients=48, requests_per_client=6,
         record["fleet_rewarm_s"] = (
             float(np.median(rewarms)) if rewarms else None
         )
-        for server in servers:
-            lifecycle = ReplicaLifecycle()
+        for ri, server in enumerate(servers):
+            lifecycle = ReplicaLifecycle(name=f"replica-{ri}")
             httpd = ThreadingHTTPServer(
                 ("127.0.0.1", 0), _make_handler(server, lifecycle)
             )
@@ -930,6 +943,29 @@ def bench_fleet_serving(replicas=3, clients=48, requests_per_client=6,
             httpd.server_close()
         for server in servers:
             server.close()
+    # attach each replica's flight events for the benched window (all
+    # replicas are in-process, so the one process-global recorder holds
+    # every replica's lane; the monotonic window bound keeps earlier
+    # bench stages out)
+    window = [
+        e for e in flight.get_recorder().events()
+        if e.get("mono", 0.0) >= flight_window_start
+    ]
+    by_kind: dict = {}
+    by_replica: dict = {}
+    for e in window:
+        by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+        party = e.get("party") or "-"
+        by_replica[party] = by_replica.get(party, 0) + 1
+    record["fleet_flight"] = {
+        "events": len(window),
+        "by_kind": by_kind,
+        "by_replica": by_replica,
+        # bounded raw tail: enough to reconstruct the lifecycle story
+        # (ready x N, draining, serving_drain) without bloating the
+        # BENCH record
+        "events_tail": window[-64:],
+    }
     return record
 
 
@@ -1180,6 +1216,13 @@ def main():
             record["serving_request_p99_s"] = snap[
                 "request_latency_p99_s"
             ]
+            # the latency split (ISSUE 12 satellite): queue-wait vs
+            # compute — where serving time actually goes, agreeing with
+            # the profiler's serve_queue_wait / serve_compute phases
+            record["serving_queue_wait_p99_s"] = snap.get(
+                "queue_wait_p99_s"
+            )
+            record["serving_compute_p99_s"] = snap.get("compute_p99_s")
             record["serving_deadline_misses"] = snap["deadline_misses"]
             emit()
     except Exception as e:

@@ -50,9 +50,20 @@ class ReplicaLifecycle:
     and the router ejects on readiness, never on liveness — a warming
     or draining replica is alive but must receive no traffic."""
 
-    def __init__(self):
+    def __init__(self, name: str = ""):
+        # replica identity stamped into the lifecycle flight events so
+        # multi-replica postmortems (and bench_fleet_serving's captured
+        # flight window) attribute transitions per replica
+        self.name = name
         self._state = "warming"
         self._lock = threading.Lock()
+
+    def _record(self, state: str) -> None:
+        from moose_tpu import flight
+
+        flight.record(
+            f"replica_{state}", party=self.name or None,
+        )
 
     @property
     def state(self) -> str:
@@ -61,8 +72,10 @@ class ReplicaLifecycle:
 
     def set_ready(self) -> None:
         with self._lock:
-            if self._state == "warming":
-                self._state = "ready"
+            if self._state != "warming":
+                return
+            self._state = "ready"
+        self._record("ready")
 
     def start_drain(self) -> bool:
         """Flip to draining; True only for the FIRST caller (signal
@@ -71,11 +84,13 @@ class ReplicaLifecycle:
             if self._state in ("draining", "stopped"):
                 return False
             self._state = "draining"
+        self._record("draining")
         return True
 
     def stopped(self) -> None:
         with self._lock:
             self._state = "stopped"
+        self._record("stopped")
 
 
 def parse_models(specs) -> dict:
@@ -307,6 +322,17 @@ def _make_handler(server, lifecycle=None):
                     {"status": state,
                      "models": server.registry.names()},
                 )
+            elif self.path.split("?", 1)[0] == "/debug/profile":
+                # bounded on-demand profile capture (?seconds=N): the
+                # serving-side per-request opt-in — see
+                # moose_tpu/profiling.py and DEVELOP.md "Profiling"
+                from moose_tpu import profiling
+
+                query = (
+                    self.path.split("?", 1)[1] if "?" in self.path else ""
+                )
+                status, payload = profiling.handle_profile_request(query)
+                self._reply(status, payload)
             elif self.path == "/v1/metrics":
                 self._reply(200, server.metrics_snapshot())
             elif self.path == "/metrics":

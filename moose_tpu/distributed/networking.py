@@ -304,16 +304,22 @@ class LocalNetworking:
 
     def send(self, value, receiver: str, rendezvous_key: str,
              session_id: str):
+        from .. import profiling
         from ..serde import serialize_value
 
-        payload = (
-            serialize_value(value) if self._serialize else value
-        )
+        if self._serialize:
+            with profiling.phase("serde", direction="tx"):
+                payload = serialize_value(value)
+        else:
+            payload = value
         m = _net_metrics()
         m["sends"].inc(transport="local")
         if self._serialize:
             m["tx_bytes"].inc(len(payload), transport="local")
         self._store.put(transfer_key(session_id, rendezvous_key), payload)
+        # transmitted bytes (the cost-drift watchdog tallies these per
+        # session; None when the payload never hit the wire codec)
+        return len(payload) if self._serialize else None
 
     def send_many(self, items, receiver: str, session_id: str):
         """Coalesced delivery of ``[(rendezvous_key, value), ...]`` to
@@ -323,8 +329,15 @@ class LocalNetworking:
         m = _net_metrics()
         m["send_many"].inc(transport="local")
         m["send_many_payloads"].inc(len(items), transport="local")
+        total = 0
+        unknown = False
         for rendezvous_key, value in items:
-            self.send(value, receiver, rendezvous_key, session_id)
+            sent = self.send(value, receiver, rendezvous_key, session_id)
+            if sent is None:
+                unknown = True
+            else:
+                total += sent
+        return None if unknown else total
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
@@ -338,8 +351,11 @@ class LocalNetworking:
         m = _net_metrics()
         m["receives"].inc(transport="local")
         if self._serialize:
+            from .. import profiling
+
             m["rx_bytes"].inc(len(payload), transport="local")
-            return deserialize_value(payload, plc)
+            with profiling.phase("serde", direction="rx"):
+                return deserialize_value(payload, plc)
         return payload
 
     def activity_for(self, session_id: str):
@@ -359,8 +375,11 @@ class LocalNetworking:
         m = _net_metrics()
         m["receives"].inc(transport="local")
         if self._serialize:
+            from .. import profiling
+
             m["rx_bytes"].inc(len(payload), transport="local")
-            return True, deserialize_value(payload, plc)
+            with profiling.phase("serde", direction="rx"):
+                return True, deserialize_value(payload, plc)
         return True, payload
 
 
@@ -415,7 +434,7 @@ class TcpNetworking:
         while True:
             try:
                 tcp.send(self._lib, host, int(port), key, payload)
-                return
+                return len(payload)
             except NetworkingError:
                 if time.monotonic() > deadline:
                     raise
@@ -626,17 +645,20 @@ class GrpcNetworking:
 
     def send(self, value, receiver: str, rendezvous_key: str,
              session_id: str):
+        from .. import profiling
         from ..serde import serialize_value
 
-        frame = pack_value_frame(
-            self._identity,
-            transfer_key(session_id, rendezvous_key),
-            serialize_value(value),
-        )
+        with profiling.phase("serde", direction="tx"):
+            frame = pack_value_frame(
+                self._identity,
+                transfer_key(session_id, rendezvous_key),
+                serialize_value(value),
+            )
         m = _net_metrics()
         m["sends"].inc(transport="grpc")
         m["tx_bytes"].inc(len(frame), transport="grpc")
         self._transmit(receiver, frame)
+        return len(frame)
 
     def send_many(self, items, receiver: str, session_id: str):
         """One SendValue rpc carrying several rendezvous payloads
@@ -644,24 +666,28 @@ class GrpcNetworking:
         coalesces same-destination sends at segment boundaries so a
         protocol round costs one envelope per peer instead of one rpc
         per tensor."""
+        from .. import profiling
         from ..serde import serialize_value
 
-        frame = pack_batch_frame(
-            self._identity,
-            [
-                (transfer_key(session_id, key), serialize_value(value))
-                for key, value in items
-            ],
-        )
+        with profiling.phase("serde", direction="tx", payloads=len(items)):
+            frame = pack_batch_frame(
+                self._identity,
+                [
+                    (transfer_key(session_id, key), serialize_value(value))
+                    for key, value in items
+                ],
+            )
         m = _net_metrics()
         m["send_many"].inc(transport="grpc")
         m["send_many_payloads"].inc(len(items), transport="grpc")
         m["tx_bytes"].inc(len(frame), transport="grpc")
         self._transmit(receiver, frame)
+        return len(frame)
 
     def receive(self, sender: str, rendezvous_key: str, session_id: str,
                 plc: str = "", timeout: float = DEFAULT_TIMEOUT_S,
                 cancel=None, progress=None):
+        from .. import profiling
         from ..serde import deserialize_value
 
         payload = self.cells.get(
@@ -669,7 +695,8 @@ class GrpcNetworking:
             progress,
         )
         _net_metrics()["receives"].inc(transport="grpc")
-        return deserialize_value(payload, plc)
+        with profiling.phase("serde", direction="rx"):
+            return deserialize_value(payload, plc)
 
     def activity_for(self, session_id: str):
         return self.cells.activity_for(session_id)
@@ -685,4 +712,7 @@ class GrpcNetworking:
         if not ok:
             return False, None
         _net_metrics()["receives"].inc(transport="grpc")
-        return True, deserialize_value(payload, plc)
+        from .. import profiling
+
+        with profiling.phase("serde", direction="rx"):
+            return True, deserialize_value(payload, plc)

@@ -179,6 +179,206 @@ def plan_stats() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# cost-model drift watchdog (ISSUE 12): the PR-7 analyzer's predictions
+# are compared against what this worker MEASURED, continuously, on every
+# planned session — not only in the dist_smoke CI gate.  A mismatch is
+# the standing alarm that the planner's cost inputs drifted from the
+# runtime wire path (counter + flight event; the session itself is never
+# failed by its own observability).
+# ---------------------------------------------------------------------------
+
+
+def _drift_fault_applies(identity: str) -> bool:
+    """TEST-ONLY (MOOSE_TPU_DRIFT_FAULT): ``1`` perturbs every party's
+    coalescing, a party name perturbs only that party — the watchdog
+    coverage hook, mirroring MOOSE_TPU_SELFCHECK_FAULT's role for the
+    ladder."""
+    raw = os.environ.get("MOOSE_TPU_DRIFT_FAULT", "")
+    return raw == "1" or (bool(raw) and raw == identity)
+
+
+def _watchdog_enabled() -> bool:
+    return os.environ.get("MOOSE_TPU_COST_WATCHDOG", "1") != "0"
+
+
+# (cost report, value specs) per computation, keyed by (transport,
+# session-id length) — the only two inputs the wire prediction depends
+# on besides the graph itself.  Weak-keyed like the plan cache: serving
+# traffic must not re-serialize placeholder payloads per session.
+_cost_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+_DRIFT_COUNTER = None
+_WATCHDOG_COUNTER = None
+
+
+def _drift_counter():
+    global _DRIFT_COUNTER
+    if _DRIFT_COUNTER is None:
+        from .. import metrics
+
+        _DRIFT_COUNTER = metrics.counter(
+            "moose_tpu_cost_drift_total",
+            "cost-model predictions contradicted by measured session "
+            "counters, by kind (the planner's cost inputs drifted)",
+            ("kind",),
+        )
+    return _DRIFT_COUNTER
+
+
+def _watchdog_counter():
+    global _WATCHDOG_COUNTER
+    if _WATCHDOG_COUNTER is None:
+        from .. import metrics
+
+        _WATCHDOG_COUNTER = metrics.counter(
+            "moose_tpu_cost_watchdog_sessions_total",
+            "planned sessions screened by the cost-drift watchdog, by "
+            "outcome (ok / drift / skipped)",
+            ("outcome",),
+        )
+    return _WATCHDOG_COUNTER
+
+
+def _watchdog_transport(networking) -> Optional[str]:
+    """The cost-model transport semantics matching ``networking``, or
+    None when no exact prediction exists: ChaosNetworking decomposes
+    coalescing fault-by-fault, TcpNetworking has no ``send_many``, and
+    a non-serializing LocalNetworking never touches the wire codec."""
+    name = type(networking).__name__
+    if name == "GrpcNetworking":
+        return "grpc"
+    if name == "LocalNetworking":
+        return "local" if getattr(networking, "_serialize", False) else None
+    return None
+
+
+def _cost_prediction(comp, transport: str, session_id: str):
+    key = (transport, len(session_id))
+    with _cache_lock:
+        per_comp = _cost_cache.get(comp)
+        if per_comp is None:
+            per_comp = _cost_cache[comp] = {}
+        entry = per_comp.get(key)
+    if entry is not None:
+        return entry
+    from ..compilation.analysis.cost import cost_report, infer_specs
+
+    entry = (
+        cost_report(comp, session_id=session_id, transport=transport),
+        infer_specs(comp),
+    )
+    with _cache_lock:
+        per_comp[key] = entry
+    return entry
+
+
+def _live_bytes_overruns(plan, env: dict, specs, cap: int = 4):
+    """Boundary values whose REAL in-memory bytes exceed the model's
+    ``memory_bytes`` — the observable inputs of the MSA603 live-buffer
+    high-water marks.  Undercounting is the drift that matters (the hwm
+    stops being an upper bound); a conservative model is fine."""
+    import jax
+
+    from ..compilation.analysis.cost import memory_bytes
+
+    over: dict = {}
+    names: set = set()
+    for seg in plan.segments:
+        names.update(seg.in_names)
+        names.update(seg.out_names)
+    for name in sorted(names):
+        value = env.get(name)
+        spec = specs.get(name)
+        if value is None or spec is None:
+            continue
+        predicted = memory_bytes(spec)
+        if predicted is None:
+            continue
+        measured = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(value)
+        )
+        if measured > predicted:
+            over[name] = {"predicted": predicted, "measured": measured}
+            if len(over) >= cap:
+                break
+    return over
+
+
+def check_cost_drift(comp, identity: str, session_id: str, networking,
+                     sender, receives: int, env: dict,
+                     plan) -> Optional[dict]:
+    """Compare this party's measured session counters (singles,
+    coalesced envelopes/payloads, tx bytes, receives, boundary value
+    bytes) against the static cost model's per-party prediction.  On
+    mismatch: ONE ``cost_drift`` flight event for the session carrying
+    every mismatched kind, plus ``moose_tpu_cost_drift_total{kind}``
+    increments.  Returns the mismatch dict (None when clean/skipped) —
+    and NEVER raises: the watchdog explains sessions, it must not fail
+    them."""
+    from ..logger import get_logger
+
+    try:
+        if not _watchdog_enabled():
+            return None
+        transport = _watchdog_transport(networking)
+        if transport is None:
+            _watchdog_counter().inc(outcome="skipped")
+            return None
+        report, specs = _cost_prediction(comp, transport, session_id)
+        party = report["per_party"].get(identity)
+        if party is None or party["unresolved_sends"]:
+            _watchdog_counter().inc(outcome="skipped")
+            return None
+        stats = sender.stats
+        measured = {
+            "send_many_envelopes": stats["envelopes"],
+            "send_many_payloads": stats["env_payloads"],
+            # local transports count coalesced payloads as sends too
+            # (send_many delegates to send); grpc sends one rpc frame
+            "sends": stats["singles"] + (
+                stats["env_payloads"] if transport != "grpc" else 0
+            ),
+            "receives": int(receives),
+        }
+        predicted = {k: int(party[k]) for k in measured}
+        tx = sender.measured_tx_bytes
+        if tx is not None:
+            measured["tx_bytes"] = int(tx)
+            predicted["tx_bytes"] = int(party["tx_bytes"])
+        mismatches = {
+            k: {"predicted": predicted[k], "measured": measured[k]}
+            for k in measured
+            if measured[k] != predicted[k]
+        }
+        over = _live_bytes_overruns(plan, env, specs)
+        if over:
+            mismatches["live_bytes"] = over
+        if not mismatches:
+            _watchdog_counter().inc(outcome="ok")
+            return None
+        _watchdog_counter().inc(outcome="drift")
+        for kind in mismatches:
+            _drift_counter().inc(kind=kind)
+        from .. import flight
+
+        flight.record(
+            "cost_drift", party=identity, session=session_id,
+            transport=transport, mismatches=mismatches,
+        )
+        get_logger().warning(
+            "cost-model drift on %s (session %s): %s — the static "
+            "analyzer's prediction no longer matches the runtime wire "
+            "path", identity, session_id, sorted(mismatches),
+        )
+        return mismatches
+    except Exception as e:  # noqa: BLE001 — observability must never
+        # fail the session it observes
+        get_logger().debug("cost-drift watchdog errored: %s", e)
+        return None
+
+
+# ---------------------------------------------------------------------------
 # segments
 # ---------------------------------------------------------------------------
 
@@ -268,11 +468,19 @@ class _Segment:
         # validating: the eager result is the reference AND the value
         # the session continues from — a divergent candidate never
         # contaminates the protocol
-        ref = self._eager_fn()(env_in)
+        from .. import profiling
+
         pin = False
         ok = False
+        with profiling.phase(
+            "ladder_validate", segment=self.index, party=self._identity,
+        ):
+            ref = self._eager_fn()(env_in)
         try:
-            got = self._jit_fn()(env_in)
+            with profiling.phase(
+                "ladder_validate", segment=self.index, party=self._identity,
+            ):
+                got = self._jit_fn()(env_in)
             ok = _results_equal(ref, got)
             pin = not ok
         except Exception as e:  # noqa: BLE001 — candidate is optional
@@ -493,6 +701,17 @@ class _AsyncSender:
         self._pending = 0
         self._closed = False
         self._error = None
+        # per-session measured wire stats (the cost-drift watchdog
+        # compares these against the static cost model's prediction for
+        # this party): singles = payloads transmitted one send() each,
+        # envelopes/env_payloads = coalesced send_many units, tx_bytes =
+        # sum of the transport's reported transmitted bytes (None once
+        # any transmission couldn't report a size)
+        self.stats = {
+            "singles": 0, "envelopes": 0, "env_payloads": 0,
+            "tx_bytes": 0,
+        }
+        self._bytes_unknown = False
         self._thread = threading.Thread(
             target=self._run_thread, daemon=True, name="moose-sender",
         )
@@ -530,6 +749,21 @@ class _AsyncSender:
                 buckets[receiver] = []
                 order.append(receiver)
             buckets[receiver].append((key, value))
+        if _drift_fault_applies(self._identity):
+            # TEST-ONLY perturbation (MOOSE_TPU_DRIFT_FAULT): transmit
+            # every payload as its own singleton unit, deliberately
+            # breaking the deterministic coalescing the static cost
+            # model predicts — the watchdog must flag this session as
+            # cost_drift (tests/test_profiling.py)
+            with self._cv:
+                if self._error is not None:
+                    return
+                for receiver in order:
+                    for payload in buckets[receiver]:
+                        self._items.append((receiver, [payload]))
+                        self._pending += 1
+                self._cv.notify()
+            return
         with self._cv:
             if self._error is not None:
                 return
@@ -566,14 +800,24 @@ class _AsyncSender:
                     self._cv.notify_all()
 
     def _transmit(self, receiver: str, payloads: list) -> None:
-        from .. import flight
+        from .. import flight, profiling
 
         send_many = getattr(self._net, "send_many", None)
-        if len(payloads) > 1 and send_many is not None:
-            send_many(payloads, receiver, self._session_id)
-        else:
-            for key, value in payloads:
-                self._net.send(value, receiver, key, self._session_id)
+        with profiling.phase(
+            "net_send", receiver=receiver, payloads=len(payloads),
+        ):
+            if len(payloads) > 1 and send_many is not None:
+                sent = send_many(payloads, receiver, self._session_id)
+                self.stats["envelopes"] += 1
+                self.stats["env_payloads"] += len(payloads)
+                self._tally_bytes(sent)
+            else:
+                for key, value in payloads:
+                    sent = self._net.send(
+                        value, receiver, key, self._session_id
+                    )
+                    self.stats["singles"] += 1
+                    self._tally_bytes(sent)
         flight.record(
             "send", party=self._identity or None,
             session=self._session_id, receiver=receiver,
@@ -581,6 +825,19 @@ class _AsyncSender:
         )
         if self._progress is not None:
             self._progress.bump()
+
+    def _tally_bytes(self, sent) -> None:
+        if sent is None:
+            self._bytes_unknown = True
+        elif not self._bytes_unknown:
+            self.stats["tx_bytes"] += int(sent)
+
+    @property
+    def measured_tx_bytes(self):
+        """Transmitted bytes this session, or None when any transport
+        call couldn't report a size (watchdog then skips the bytes
+        comparison instead of flagging a phantom drift)."""
+        return None if self._bytes_unknown else self.stats["tx_bytes"]
 
     def flush(self, timeout: float, cancel=None) -> None:
         """Block until every enqueued send has been transmitted (the
@@ -741,13 +998,19 @@ class _ReceivePrefetcher:
         from .. import flight
         from .networking import sliced_wait
 
+        from .. import profiling
+
         op = self._ops[name]
         hit = self._events[name].is_set()
         _prefetch_counter().inc(outcome="hit" if hit else "wait")
-        sliced_wait(
-            self._events[name].wait, self._timeout, self._cancel,
-            op.attributes["rendezvous_key"], self._progress,
-        )
+        with profiling.phase(
+            "net_receive", key=op.attributes.get("rendezvous_key", ""),
+            prefetched=hit,
+        ):
+            sliced_wait(
+                self._events[name].wait, self._timeout, self._cancel,
+                op.attributes["rendezvous_key"], self._progress,
+            )
         flight.record(
             "receive", party=self._identity, session=self._session_id,
             sender=op.attributes.get("sender"),
@@ -815,6 +1078,7 @@ def execute_role_planned(
         timeout, abort_any, progress, fail,
     )
     validated = False
+    receives_measured = 0
     with telemetry.span(
         "execute_role", party=identity, steps=len(plan.steps),
     ) as root:
@@ -825,6 +1089,8 @@ def execute_role_planned(
                         f"session {session_id} aborted"
                     )
                 if kind == "seg":
+                    from .. import profiling
+
                     seg = plan.segments[payload]
                     with telemetry.span(
                         "worker_segment", party=identity,
@@ -835,6 +1101,10 @@ def execute_role_planned(
                             {n: env[n] for n in seg.in_names},
                             session_id=session_id,
                         )
+                        # device-fenced only while a profiler is active:
+                        # the worker_segment phase then owns its device
+                        # time instead of the next blocking call
+                        profiling.fence(out)
                     env.update(out)
                     validated |= did_validate
                     progress.bump()
@@ -870,6 +1140,7 @@ def execute_role_planned(
                     env[payload] = HostUnit(identity)
                 elif op.kind == "Receive":
                     env[payload] = prefetcher.wait(payload)
+                    receives_measured += 1
                 elif op.kind == "Sample":
                     # unseeded draw: a hard segment boundary (jitting it
                     # would bake one draw into the compiled program) but
@@ -911,6 +1182,13 @@ def execute_role_planned(
         raise exc
     if cancel is not None and cancel.is_set():
         raise SessionAbortedError(f"session {session_id} aborted")
+
+    # the session SUCCEEDED: screen its measured wire/memory counters
+    # against the static cost model (continuous drift watchdog)
+    check_cost_drift(
+        comp, identity, session_id, networking, sender,
+        receives_measured, env, plan,
+    )
 
     elapsed = int((time.perf_counter() - t0) * 1e6)
     return {

@@ -686,11 +686,20 @@ class _SelfCheckBase:
         if self._per_op is not None:
             return self._run_per_op_validation(*args)
 
-        ref = self._invoke(self._ref_fn, *args)
-        try:
-            got = self._invoke(self._jit_fn, *args)
-            ok = _results_equal(ref, got)
-        except Exception as e:  # noqa: BLE001 — candidate is optional
+        from .. import profiling
+
+        run_error = None
+        with profiling.phase(
+            "ladder_validate", rung=self._rung_label(self._level),
+        ):
+            ref = self._invoke(self._ref_fn, *args)
+            try:
+                got = self._invoke(self._jit_fn, *args)
+                ok = _results_equal(ref, got)
+            except Exception as e:  # noqa: BLE001 — candidate is
+                # optional; classified below, outside the timed phase
+                run_error = e
+        if run_error is not None:
             # a run failure (transient OOM, tunnel hiccup) is NOT the
             # divergence the ladder exists for: retry this rung once
             # before burning it
@@ -698,11 +707,12 @@ class _SelfCheckBase:
                 self._run_failed_once = True
                 get_logger().warning(
                     "jit self-check candidate failed to run (%s); will "
-                    "retry this segment size once", e
+                    "retry this segment size once", run_error
                 )
                 return ref
             get_logger().warning(
-                "jit self-check candidate failed twice (%s); demoting", e
+                "jit self-check candidate failed twice (%s); demoting",
+                run_error,
             )
             ok = False
             got = None
@@ -765,10 +775,14 @@ class _SelfCheckBase:
         self._save_state()
 
     def _run_per_op_validation(self, *args):
+        from .. import profiling
         from ..logger import get_logger
 
         try:
-            result, new_pins, retried = self._per_op.run_validate(*args)
+            with profiling.phase("ladder_validate", rung="per-op"):
+                result, new_pins, retried = self._per_op.run_validate(
+                    *args
+                )
         except Exception as e:  # noqa: BLE001 — candidate is optional
             self._descent.append("eager")
             self._announce_resolution(
@@ -1181,15 +1195,25 @@ def build_segmented_runner(order, static_env, dynamic_names,
     seg_fns = [make_seg(si, names) for si, names in enumerate(chunks)]
 
     def run(rand, dyn: dict):
+        from .. import profiling
+
         env: dict[str, Any] = {}
         outputs: dict[str, Any] = {}
         saves: dict[tuple[str, str], Any] = {}
         for si, fn in enumerate(seg_fns):
-            env_out, out_i, sv_i = fn(
-                rand_slice(rand, si),
-                {n: dyn[n] for n in dyn_of[si]},
-                {n: env[n] for n in in_names[si]},
-            )
+            # device-fenced profiling phase: while a capture window is
+            # active the segment owns its device time (jax dispatch is
+            # async — without the fence it would be misattributed to
+            # whichever later phase first blocks); no-op otherwise
+            with profiling.phase(
+                "segment_execute", segment=si, ops=len(chunks[si]),
+            ):
+                env_out, out_i, sv_i = fn(
+                    rand_slice(rand, si),
+                    {n: dyn[n] for n in dyn_of[si]},
+                    {n: env[n] for n in in_names[si]},
+                )
+                profiling.fence(env_out, out_i, sv_i)
             env.update(env_out)
             outputs.update(out_i)
             saves.update(sv_i)
@@ -1474,12 +1498,19 @@ class Interpreter:
             # all transfers start before any blocks: the per-output numpy
             # conversions below then overlap instead of serializing
             prefetch_to_host(outputs, saves)
-            for (plc_name, key), value in saves.items():
-                storage.setdefault(plc_name, {})[key] = _to_user_value(value)
-            return {
-                name: _to_user_value(outputs[name])
-                for name in ordered_output_names(outputs)
-            }
+            from .. import profiling
+
+            with profiling.phase(
+                "host_transfer", outputs=len(outputs), saves=len(saves),
+            ):
+                for (plc_name, key), value in saves.items():
+                    storage.setdefault(plc_name, {})[key] = (
+                        _to_user_value(value)
+                    )
+                return {
+                    name: _to_user_value(outputs[name])
+                    for name in ordered_output_names(outputs)
+                }
 
     def _resolve_load_key(self, plan, comp, op, arguments) -> str:
         key_val = plan.static_env.get(op.inputs[0])

@@ -24,8 +24,19 @@ party that recorded it and the session it belongs to.
 
 Events are plain dicts so they serialize over msgpack/JSON unchanged::
 
-    {"seq": 17, "ts": 1754..., "kind": "send", "party": "alice",
-     "session": "ab12...", "receiver": "bob", "keys": 3}
+    {"seq": 17, "ts": 1754..., "mono": 812.44, "kind": "send",
+     "party": "alice", "session": "ab12...", "receiver": "bob",
+     "keys": 3}
+
+``ts`` is wall-clock (human-readable, comparable across hosts to clock
+skew); ``mono`` is the process monotonic clock — exact ORDER within one
+process regardless of NTP steps, which is what postmortems of ring
+events need.
+
+Pretty-print a JSONL dump (one line per event, aligned, sorted)::
+
+    python -m moose_tpu.flight events.jsonl [--session S] [--party P]
+        [--kind K] [--tail N]
 """
 
 from __future__ import annotations
@@ -74,6 +85,11 @@ class FlightRecorder:
             event = {
                 "seq": self._seq,
                 "ts": time.time(),
+                # monotonic clock alongside wall time: wall clocks skew
+                # across parties, so cross-party event ORDER (ring
+                # events especially) keys on this within one host and
+                # on per-party (mono, seq) lanes across hosts
+                "mono": time.monotonic(),
                 "kind": str(kind),
             }
             if party is not None:
@@ -171,3 +187,120 @@ def configure(capacity: Optional[int] = None,
             capacity=capacity, stream_path=stream_path
         )
         return _recorder
+
+
+# ---------------------------------------------------------------------------
+# JSONL pretty-printer: python -m moose_tpu.flight events.jsonl
+# ---------------------------------------------------------------------------
+
+_CORE_FIELDS = ("seq", "ts", "mono", "kind", "party", "session")
+
+
+def format_event(event: dict) -> str:
+    """One aligned human line per event: clock columns, then kind /
+    party / session, then every extra field as key=value."""
+    import datetime
+
+    ts = event.get("ts")
+    when = (
+        datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S.%f")[:-3]
+        if isinstance(ts, (int, float))
+        else "?"
+    )
+    mono = event.get("mono")
+    mono_s = f"{mono:14.6f}" if isinstance(mono, (int, float)) else " " * 14
+    session = event.get("session") or "-"
+    extras = " ".join(
+        f"{k}={json.dumps(v, default=str)}"
+        for k, v in event.items()
+        if k not in _CORE_FIELDS
+    )
+    return (
+        f"{event.get('seq', '?'):>6} {when} {mono_s} "
+        f"{event.get('party') or '-':<10} "
+        f"{event.get('kind', '?'):<18} {session[:12]:<12} {extras}"
+    ).rstrip()
+
+
+def _sort_key_fn(events):
+    # per-party monotonic lanes order exactly; across parties the lanes
+    # interleave by wall clock (skew-limited), with seq as tiebreaker.
+    # Each party's mono clock is mapped onto the wall timeline with one
+    # constant offset (median of wall - mono, robust to an NTP step
+    # mid-run), so a wall-clock correction can never reorder a party's
+    # own events.
+    offsets: dict = {}
+    for e in events:
+        mono = e.get("mono")
+        if isinstance(mono, (int, float)) and "ts" in e:
+            offsets.setdefault(e.get("party"), []).append(e["ts"] - mono)
+    medians = {
+        party: sorted(deltas)[len(deltas) // 2]
+        for party, deltas in offsets.items()
+    }
+
+    def key(event: dict):
+        mono = event.get("mono")
+        ts = event.get("ts", 0)
+        if isinstance(mono, (int, float)):
+            offset = medians.get(event.get("party"))
+            if offset is not None:
+                ts = offset + mono
+        return (ts, event.get("seq", 0))
+
+    return key
+
+
+def main(argv=None) -> int:
+    """Pretty-print a MOOSE_TPU_FLIGHT JSONL dump."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m moose_tpu.flight",
+        description="pretty-print a flight-recorder JSONL dump",
+    )
+    parser.add_argument("path", help="events.jsonl (MOOSE_TPU_FLIGHT)")
+    parser.add_argument("--session", default=None,
+                        help="only events of this session id")
+    parser.add_argument("--party", default=None,
+                        help="only events recorded by this party")
+    parser.add_argument("--kind", default=None,
+                        help="only events of this kind")
+    parser.add_argument("--tail", type=int, default=None, metavar="N",
+                        help="only the newest N events after filtering")
+    args = parser.parse_args(argv)
+
+    events = []
+    bad = 0
+    with open(args.path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1  # torn tail line of a crashed writer
+    events = [
+        e for e in events
+        if (args.session is None or e.get("session") == args.session)
+        and (args.party is None or e.get("party") == args.party)
+        and (args.kind is None or e.get("kind") == args.kind)
+    ]
+    events.sort(key=_sort_key_fn(events))
+    if args.tail is not None:
+        events = events[-args.tail:] if args.tail > 0 else []
+    print(
+        f"{'seq':>6} {'wall':<12} {'mono':>14} {'party':<10} "
+        f"{'kind':<18} {'session':<12} fields"
+    )
+    for event in events:
+        print(format_event(event))
+    if bad:
+        print(f"# skipped {bad} unparseable line(s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -383,6 +383,23 @@ class MetricsServer:
                         registry.render_prometheus().encode(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
+                elif self.path.split("?", 1)[0] == "/debug/profile":
+                    # bounded on-demand profile capture: the worker-side
+                    # per-request opt-in (moose_tpu/profiling.py)
+                    from . import profiling
+
+                    query = (
+                        self.path.split("?", 1)[1]
+                        if "?" in self.path else ""
+                    )
+                    status, payload = profiling.handle_profile_request(
+                        query
+                    )
+                    self._reply(
+                        status,
+                        json.dumps(payload).encode(),
+                        "application/json",
+                    )
                 elif self.path == "/v1/metrics":
                     self._reply(
                         200,

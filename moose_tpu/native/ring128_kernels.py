@@ -216,6 +216,13 @@ def dispatch(kernel: str, width: int) -> bool:
                         _STATE[key] = state
     if state == "ok":
         _count_dispatch(kernel)
+        from .. import profiling
+
+        # trace-time marker on the profile timeline: which primitives
+        # actually routed into their Pallas kernels during this capture
+        profiling.record_instant(
+            "pallas_dispatch", kernel=kernel, width=width,
+        )
         return True
     return False
 
@@ -239,11 +246,14 @@ def _run_first_use_check(kernel: str, width: int) -> str:
         finally:
             _IN_CHECK.active = False
 
-    t = threading.Thread(
-        target=worker, name=f"pallas-check-{kernel}-{width}"
-    )
-    t.start()
-    t.join()
+    from .. import profiling
+
+    with profiling.phase("pallas_selfcheck", kernel=kernel, width=width):
+        t = threading.Thread(
+            target=worker, name=f"pallas-check-{kernel}-{width}"
+        )
+        t.start()
+        t.join()
     try:
         exc = box.get("exc")
         if exc is not None:

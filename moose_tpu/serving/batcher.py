@@ -163,6 +163,9 @@ class ModelQueue:
         everything finished in time.  Call :meth:`close` afterwards to
         stop the scheduler thread (any leftovers then complete with the
         same retryable error)."""
+        from .. import flight
+
+        flight.record("serving_drain", model=self.model.name)
         deadline = time.perf_counter() + timeout_s
         with self._cv:
             self._draining = True
@@ -294,6 +297,18 @@ class ModelQueue:
             live.append(request)
         if not live:
             return
+        from .. import profiling
+
+        # queue-wait component per request, measured at the two instants
+        # the batcher already owns (submit -> dispatch claim): the
+        # serving latency finally decomposes into where it actually goes
+        # — and the profiler's timeline and Prometheus agree on it
+        for request in live:
+            self.metrics.record_queue_wait(now - request.enqueued_s)
+            profiling.record_complete(
+                "serve_queue_wait", request.enqueued_s, now,
+                model=self.model.name,
+            )
         with telemetry.span(
             "serve_batch",
             model=self.model.name,
@@ -304,7 +319,18 @@ class ModelQueue:
                 padded, bucket = self.model.pad(rows)
                 sp.attrs["rows"] = int(rows.shape[0])
                 sp.attrs["bucket"] = int(bucket)
-                result, report = self.registry.evaluate(self.model, padded)
+                t_compute = time.perf_counter()
+                with profiling.phase(
+                    "serve_compute", model=self.model.name,
+                    bucket=int(bucket),
+                ):
+                    result, report = self.registry.evaluate(
+                        self.model, padded
+                    )
+                    profiling.fence(result)
+                compute_s = time.perf_counter() - t_compute
+                self.metrics.record_compute(compute_s)
+                sp.attrs["compute_s"] = compute_s
             except Exception as e:  # noqa: BLE001 — the batch fails as
                 # a unit; every caller gets the typed root cause (and
                 # the scheduler thread survives to serve later batches)

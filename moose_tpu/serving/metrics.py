@@ -63,6 +63,20 @@ def _registry_metrics():
             "moose_tpu_serving_request_latency_seconds",
             "request latency from submit to scatter",
         ),
+        # the serve_batch latency, DECOMPOSED (ISSUE 12): queue-wait is
+        # submit -> dispatch claim per request; compute is one batch's
+        # evaluation.  The profiler's serve_queue_wait / serve_compute
+        # phases record the identical instants, so the Perfetto
+        # timeline and a Prometheus scrape agree on where serving time
+        # goes.
+        "queue_wait": metrics.histogram(
+            "moose_tpu_serving_queue_wait_seconds",
+            "per-request wait from submit to batch dispatch claim",
+        ),
+        "compute": metrics.histogram(
+            "moose_tpu_serving_compute_seconds",
+            "per-batch evaluation time (registry.evaluate)",
+        ),
         # the warm-registry acceptance counters, scrapeable: the fleet
         # smoke asserts a snapshot-restored replica holds both at 0
         # from its /metrics endpoint alone (no in-process access)
@@ -105,8 +119,11 @@ class ServingMetrics:
         # acceptance counters: both must stay 0 after registration
         self.retraces_after_warm = 0
         self.validating_after_warm = 0
-        # most recent request latencies (seconds), bounded
+        # most recent request latencies (seconds), bounded — plus the
+        # two components the batcher decomposes them into
         self._latencies = deque(maxlen=latency_window)
+        self._queue_waits = deque(maxlen=latency_window)
+        self._computes = deque(maxlen=latency_window)
 
     def record_batch(self, rows: int, bucket: int, retraced: bool,
                      validating: bool) -> None:
@@ -136,6 +153,18 @@ class ServingMetrics:
         self._registry["latency"].observe(seconds)
         if missed_deadline:
             self._registry["deadline_misses"].inc()
+
+    def record_queue_wait(self, seconds: float) -> None:
+        """One request's submit -> dispatch-claim wait."""
+        with self._lock:
+            self._queue_waits.append(seconds)
+        self._registry["queue_wait"].observe(seconds)
+
+    def record_compute(self, seconds: float) -> None:
+        """One batch's evaluation time."""
+        with self._lock:
+            self._computes.append(seconds)
+        self._registry["compute"].observe(seconds)
 
     def record_deadline_drop(self) -> None:
         with self._lock:
@@ -173,12 +202,16 @@ class ServingMetrics:
             self.overloads = 0
             self.eval_failures = 0
             self._latencies.clear()
+            self._queue_waits.clear()
+            self._computes.clear()
 
     def snapshot(self) -> dict:
         """One JSON-able dict of every aggregate (the ``blitzen``
         ``/v1/metrics`` payload and the bench/smoke assertion surface)."""
         with self._lock:
             lat = sorted(self._latencies)
+            waits = sorted(self._queue_waits)
+            computes = sorted(self._computes)
             batches = self.batches
             return {
                 "batches": batches,
@@ -189,6 +222,10 @@ class ServingMetrics:
                 "batch_size_hist": dict(self.batch_size_hist),
                 "request_latency_p50_s": _quantile(lat, 0.50),
                 "request_latency_p99_s": _quantile(lat, 0.99),
+                "queue_wait_p50_s": _quantile(waits, 0.50),
+                "queue_wait_p99_s": _quantile(waits, 0.99),
+                "compute_p50_s": _quantile(computes, 0.50),
+                "compute_p99_s": _quantile(computes, 0.99),
                 "deadline_misses": self.deadline_misses,
                 "deadline_drops": self.deadline_drops,
                 "overloads": self.overloads,

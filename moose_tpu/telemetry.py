@@ -178,6 +178,20 @@ def _echo_enabled() -> bool:
     return os.environ.get("MOOSE_TPU_TRACE", "0") not in ("0", "")
 
 
+# Completed-span hook: the profiling module (moose_tpu/profiling.py)
+# installs one while a capture window is active, so EVERY span — not
+# just roots — lands on its timeline with the propagated trace ids.
+# One None check on the span-close path when no profiler runs.
+_span_hook = None
+
+
+def set_span_hook(hook) -> None:
+    """Install (or clear, with ``None``) the completed-span callback.
+    Owned by the profiling module; the hook must never raise."""
+    global _span_hook
+    _span_hook = hook
+
+
 def trace_ops_enabled() -> bool:
     """Per-op spans in eager execution (MOOSE_TPU_TRACE_OPS; read when a
     computation's plan is built)."""
@@ -207,6 +221,12 @@ def span(name: str, **attrs):
     finally:
         s.end_s = time.perf_counter()
         _state.stack.pop()
+        hook = _span_hook
+        if hook is not None:
+            try:
+                hook(s)
+            except Exception:  # noqa: BLE001 — observability must never
+                pass  # fail the operation it observes
         if parent is not None:
             parent.children.append(s)
         else:

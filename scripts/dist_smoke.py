@@ -29,6 +29,15 @@ Asserts:
    rejected at ``worker_plan.get_plan`` time with an MSA5xx diagnostic
    (flight ``plan_rejected`` event, legacy-eager fallback, typed
    failure in seconds instead of a hang).
+6. (ISSUE 12 observability) **profile smoke**: one profiled warm
+   3-worker session emits a loadable Perfetto/Chrome-trace JSON whose
+   named phases cover >=95% of the measured session wall time and
+   stitch to ONE session trace id, with the distributed phase taxonomy
+   (``execute_role`` / ``worker_segment`` / ``net_send`` /
+   ``net_receive`` / ``serde``) present; and the **cost-drift
+   watchdog** screened every warm planned session with ZERO
+   ``cost_drift`` flight events (the continuous per-session mirror of
+   gate 5).
 
 Prints one JSON summary line (the CI log artifact).
 
@@ -418,6 +427,109 @@ def check_deadlock_plan_rejected() -> dict:
     }
 
 
+def run_profile_smoke(runtime, traced, x) -> dict:
+    """ISSUE 12 acceptance: profile one warm 3-worker session.  The
+    Perfetto JSON must load, its phase events must cover >=95% of the
+    measured session wall time (merged-interval union across threads),
+    the distributed phase taxonomy must be present, and the session's
+    spans must stitch to ONE trace id."""
+    import tempfile
+    import time
+
+    from moose_tpu import profiling
+
+    fd, path = tempfile.mkstemp(prefix="moose_profile_", suffix=".json")
+    os.close(fd)
+    profiling.start(path=path)
+    try:
+        t0 = time.perf_counter()
+        runtime.run_computation(traced, {"x": x}, timeout=300.0)
+        wall_s = time.perf_counter() - t0
+    finally:
+        profiling.stop()
+    with open(path) as fh:
+        trace = json.load(fh)  # loadable-JSON gate
+    events = [
+        e for e in trace["traceEvents"] if e.get("ph") == "X"
+    ]
+    assert events, "profiled session produced no phase events"
+
+    # named-phase taxonomy: the distributed path's phases all present
+    names = {e["name"] for e in events}
+    for needle in (
+        "run_computation", "attempt", "execute_role", "worker_segment",
+        "net_send", "net_receive", "serde",
+    ):
+        assert needle in names, f"profile missing phase {needle!r}: " \
+                                f"{sorted(names)}"
+
+    # coverage: merged union of phase intervals vs measured wall time
+    intervals = sorted(
+        (e["ts"], e["ts"] + e.get("dur", 0.0)) for e in events
+    )
+    covered = 0.0
+    cur_start, cur_end = intervals[0]
+    for start, end in intervals[1:]:
+        if start <= cur_end:
+            cur_end = max(cur_end, end)
+        else:
+            covered += cur_end - cur_start
+            cur_start, cur_end = start, end
+    covered += cur_end - cur_start
+    coverage = covered / (wall_s * 1e6)
+    assert coverage >= 0.95, (
+        f"profile phases cover {coverage:.1%} of session wall time, "
+        "want >= 95%"
+    )
+
+    # stitching: the client root and every worker span share ONE id
+    trace_ids = {
+        e["args"].get("trace_id")
+        for e in events
+        if e["name"] in (
+            "run_computation", "attempt", "launch", "retrieve",
+            "execute_role", "worker_segment",
+        )
+    }
+    trace_ids.discard(None)
+    assert len(trace_ids) == 1, (
+        f"profiled session spans {len(trace_ids)} trace ids, want 1"
+    )
+    return {
+        "events": len(events),
+        "coverage": round(coverage, 4),
+        "phases": sorted(names),
+        "trace_id": next(iter(trace_ids)),
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def check_cost_watchdog_clean() -> dict:
+    """ISSUE 12 acceptance: the continuous cost-drift watchdog screened
+    the warm planned sessions above and found NOTHING — zero
+    ``cost_drift`` flight events, with the ``ok`` outcome counter
+    proving it actually ran (a silently-skipped watchdog would pass
+    vacuously)."""
+    from moose_tpu import flight, metrics
+
+    drift_events = [
+        e for e in flight.get_recorder().events()
+        if e["kind"] == "cost_drift"
+    ]
+    assert not drift_events, (
+        f"cost-drift watchdog flagged {len(drift_events)} session(s): "
+        f"{drift_events[:2]}"
+    )
+    screened_ok = metrics.REGISTRY.value(
+        "moose_tpu_cost_watchdog_sessions_total", outcome="ok"
+    )
+    assert screened_ok > 0, (
+        "the cost-drift watchdog never screened a session — the "
+        "zero-drift gate would be vacuous"
+    )
+    return {"sessions_ok": int(screened_ok), "drift_events": 0}
+
+
 def build_logreg():
     from sklearn.linear_model import LogisticRegression
 
@@ -531,6 +643,14 @@ def main() -> int:
         # predicted-vs-measured: one more warm session, counter deltas
         # must equal the static cost model exactly
         cost_gate = check_predicted_vs_measured(runtime, traced, x)
+
+        # --- ISSUE 12 observability gates -------------------------------
+        # one profiled warm session: loadable Perfetto JSON, >=95% wall
+        # coverage, stitched trace id, distributed phase taxonomy
+        profile_gate = run_profile_smoke(runtime, traced, x)
+        # the continuous cost-drift watchdog screened every planned
+        # session above and flagged nothing
+        watchdog_gate = check_cost_watchdog_clean()
     finally:
         for srv in servers.values():
             srv.stop()
@@ -555,6 +675,8 @@ def main() -> int:
         "chaos_flight": flight_summary,
         "cost_predicted_vs_measured": cost_gate,
         "deadlock_plan_rejected": deadlock_gate,
+        "profile_smoke": profile_gate,
+        "cost_watchdog": watchdog_gate,
     }
     print(json.dumps(summary), flush=True)
     return 0

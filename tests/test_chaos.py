@@ -630,9 +630,12 @@ def test_retryable_launch_failure_is_retried_to_success():
 
         t = threading.Thread(target=bring_up_carole, daemon=True)
         t.start()
+        # generous retry budget: carole's delayed start races the attempt
+        # schedule, and on a loaded box WorkerServer.start() can take
+        # seconds — the attempts must span that comfortably
         runtime = GrpcClientRuntime(
-            endpoints, max_attempts=4, backoff_base_s=0.4,
-            backoff_cap_s=1.0,
+            endpoints, max_attempts=6, backoff_base_s=0.4,
+            backoff_cap_s=1.5,
         )
         outputs, _ = runtime.run_computation(
             tracer.trace(_secure_dot_comp()), _args(), timeout=30.0
