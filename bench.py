@@ -1000,6 +1000,129 @@ def _chained_secure_dot_s(mk, da, db, t_iters=10):
     return float(np.min(times)) / t_iters
 
 
+def bench_training(features=8, rows=32, epochs=3):
+    """Secure-training bench (ISSUE 13, BENCH_r06+): a 3-worker
+    in-process gRPC cluster trains logreg for ``epochs`` epochs through
+    the TrainingSession supervisor over durable secret-shared
+    checkpoints.  Measures epoch throughput, the checkpoint
+    save(commit)/restore latency at model scale, and the wall-clock
+    overhead of one chaos-killed-and-restarted worker versus the clean
+    run (``training_resume_overhead_s`` — the price of a mid-epoch
+    recovery, backoff included)."""
+    import shutil
+    import tempfile
+
+    from moose_tpu.distributed.chaos import ChaosConfig
+    from moose_tpu.distributed.choreography import (
+        start_chaos_restarter,
+        start_local_cluster,
+    )
+    from moose_tpu.distributed.client import GrpcClientRuntime
+    from moose_tpu.predictors.trainers import LogregSGDTrainer
+    from moose_tpu.storage import FilesystemStorage
+    from moose_tpu.training import (
+        CheckpointStore,
+        TrainingConfig,
+        TrainingSession,
+    )
+    from moose_tpu.training.session import GrpcTrainingCluster
+
+    parties = ["alice", "bob", "carole"]
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(rows, features)) * 0.5
+    y = (rng.uniform(size=(rows, 1)) > 0.5).astype(np.float64)
+    record = {}
+
+    def one_run(tmp, chaos=None):
+        stores = {
+            p: CheckpointStore(
+                FilesystemStorage(os.path.join(tmp, p)), party=p
+            )
+            for p in parties
+        }
+        worker_kwargs = dict(
+            ping_interval=0.25, ping_misses=3, startup_grace=5.0,
+            receive_timeout=5.0, stall_grace=1.0,
+        )
+        servers, endpoints = start_local_cluster(
+            parties, storages=stores, chaos=chaos, **worker_kwargs,
+        )
+        stop_restarter = start_chaos_restarter(
+            servers, endpoints, stores, chaos, **worker_kwargs,
+        )
+        try:
+            client = GrpcClientRuntime(
+                endpoints, max_attempts=3, backoff_base_s=0.1,
+                backoff_cap_s=0.5,
+            )
+            session = TrainingSession(
+                LogregSGDTrainer(
+                    n_features=features, learning_rate=0.1
+                ),
+                GrpcTrainingCluster(client),
+                TrainingConfig(
+                    epochs=epochs, session_timeout_s=60,
+                    max_epoch_attempts=8, backoff_base_s=0.2,
+                    backoff_cap_s=1.0, export=False,
+                ),
+            )
+            t0 = time.perf_counter()
+            report = session.run(x, y)
+            return time.perf_counter() - t0, report, stores
+        finally:
+            stop_restarter()
+            for srv in servers.values():
+                srv.stop()
+
+    tmp_clean = tempfile.mkdtemp(prefix="bench_train_clean_")
+    tmp_chaos = tempfile.mkdtemp(prefix="bench_train_chaos_")
+    try:
+        clean_s, clean_report, stores = one_run(tmp_clean)
+        assert clean_report["ok"]
+        record["training_logreg_epochs_per_sec"] = epochs / clean_s
+        record["training_epochs"] = epochs
+        record["training_rows"] = rows
+        record["training_features"] = features
+
+        # checkpoint save/restore latency at model scale: stage one
+        # party's share pair and time commit; then time a pinned load
+        store = stores["alice"]
+        shares = {
+            key: np.asarray(store.load(key))
+            for key in ("ckpt/logreg/w#s0", "ckpt/logreg/w#s1")
+        }
+        saves, restores = [], []
+        for i in range(5):
+            for key, arr in shares.items():
+                store[key] = arr
+            t0 = time.perf_counter()
+            store.commit(epochs + 1 + i, expected=sorted(shares))
+            saves.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            for key in shares:
+                np.asarray(store.load(key))
+            restores.append(time.perf_counter() - t0)
+        record["training_checkpoint_save_s"] = float(np.median(saves))
+        record["training_checkpoint_restore_s"] = float(
+            np.median(restores)
+        )
+
+        # resume overhead: identical run with one worker chaos-killed
+        # mid-training and restarted — the wall-clock price of the
+        # recovery (detector trip + backoff + epoch re-run)
+        chaos = ChaosConfig(
+            seed=7, kill_after_ops=260, party="carole", max_kills=1
+        )
+        chaos_s, chaos_report, _ = one_run(tmp_chaos, chaos=chaos)
+        assert chaos_report["ok"] and chaos_report["resumes"] >= 1
+        record["training_resume_overhead_s"] = chaos_s - clean_s
+        record["training_resumes"] = chaos_report["resumes"]
+    finally:
+        shutil.rmtree(tmp_clean, ignore_errors=True)
+        shutil.rmtree(tmp_chaos, ignore_errors=True)
+    return record
+
+
 def main():
     rng = np.random.default_rng(42)
     a = rng.normal(size=(N, N))
@@ -1238,6 +1361,17 @@ def main():
             emit()
     except Exception as e:
         print(f"# fleet serving bench failed: {e}")
+
+    # secure training (ISSUE 13, BENCH_r06+): supervised multi-epoch
+    # logreg over secret-shared checkpoints on a 3-worker in-process
+    # gRPC cluster — epoch throughput, checkpoint save/restore latency,
+    # and the wall-clock overhead of a chaos-killed worker's recovery
+    try:
+        if _within_budget():
+            record.update(bench_training())
+            emit()
+    except Exception as e:
+        print(f"# training bench failed: {e}")
 
     # distributed worker fast path (ISSUE 5): 3-worker logreg batch-128
     # over local TCP — compiled per-role plans vs the legacy eager

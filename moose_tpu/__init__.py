@@ -76,6 +76,7 @@ from .edsl.base import (  # noqa: E402
     inverse,
     less,
     load,
+    load_shares,
     log,
     log2,
     logical_and,
@@ -94,6 +95,7 @@ from .edsl.base import (  # noqa: E402
     replicated_placement,
     reshape,
     save,
+    save_shares,
     select,
     set_current_runtime,
     shape,

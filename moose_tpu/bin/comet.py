@@ -41,6 +41,14 @@ def main(argv=None):
         "if omitted)",
     )
     parser.add_argument(
+        "--checkpoint", action="store_true",
+        default=os.environ.get("MOOSE_TPU_CHECKPOINT") == "1",
+        help="wrap the filesystem storage in a training CheckpointStore "
+        "(secret-shared checkpoint staging/commit/pin protocol + the "
+        "StorageControl rpc; requires --storage-dir; also enabled by "
+        "MOOSE_TPU_CHECKPOINT=1)",
+    )
+    parser.add_argument(
         "--tls-cert", default=None,
         help="PEM certificate chain for this identity (CN *and* a "
         "subjectAltName DNS entry must equal --identity — gRPC checks "
@@ -102,6 +110,12 @@ def main(argv=None):
         from moose_tpu.storage import FilesystemStorage
 
         storage = FilesystemStorage(args.storage_dir)
+        if args.checkpoint:
+            from moose_tpu.training.checkpoint import CheckpointStore
+
+            storage = CheckpointStore(storage, party=args.identity)
+    elif args.checkpoint:
+        parser.error("--checkpoint requires --storage-dir")
     from moose_tpu.distributed.tls import tls_config_from_flags
 
     try:

@@ -143,6 +143,114 @@ def _lift_boundary(sess, op, plc_name: str, shape, np_dtype):
     return HostTensor(SymArray(name, shape), plc_name, dtype)
 
 
+def share_key(key: str, slot: int) -> str:
+    """Party-local storage key of one element of a saved share pair.
+    Every party uses the SAME two keys — ``<key>#s0`` holds x_i (the
+    party's own additive share), ``<key>#s1`` holds x_{i+1} (its copy of
+    the next party's) — so a checkpoint directory is meaningless without
+    the other two parties' storages."""
+    return f"{key}#s{slot}"
+
+
+def _shares_of(v):
+    """(RepTensor, integral, fractional) of a replicated value."""
+    from ..values import RepFixedTensor, RepTensor
+
+    if isinstance(v, RepFixedTensor):
+        return v.tensor, v.integral_precision, v.fractional_precision
+    if isinstance(v, RepTensor):
+        return v, None, None
+    raise CompilationError(
+        f"expected a replicated sharing, found {type(v).__name__}"
+    )
+
+
+def _lower_shares_boundary(sess, comp, op, plc, env):
+    """Expand SaveShares/LoadShares into per-party ring-typed Save/Load
+    ops: party i touches ONLY the two ring tensors it already holds
+    ((x_i, x_{i+1}) of the 2-of-3 replicated sharing), through its own
+    storage — the checkpointed model never exists in the clear on any
+    host, on the wire, or at the client."""
+    from ..dialects import logical
+    from ..execution.symbolic import _ring_ty
+    from ..values import RepFixedTensor, RepTensor
+
+    if plc.kind != "Replicated":
+        raise CompilationError(
+            f"op {op.name}: {op.kind} requires a replicated placement, "
+            f"found {plc.kind}"
+        )
+    key_val = env[op.inputs[0]]
+    if not isinstance(key_val, HostString):
+        raise CompilationError(
+            f"op {op.name}: {op.kind} key must be a string constant "
+            "(checkpoint keys must be stable across sessions so "
+            "compiled-plan caches hit)"
+        )
+    key = key_val.value
+    ret = op.signature.return_type
+
+    if op.kind == "SaveShares":
+        value = logical.to_rep(
+            sess, logical._rep_placement_of(sess, plc.name),
+            env[op.inputs[1]],
+        )
+        rep_tensor, _, _ = _shares_of(value)
+        width = rep_tensor.shares[0][0].width
+        owners = comp.placements[plc.name].owners
+        last = None
+        for i, owner in enumerate(owners):
+            for slot in (0, 1):
+                share = rep_tensor.shares[i][slot]
+                key_name = sess._string_const(
+                    share_key(key, slot), owner
+                )
+                # the LAST emitted save takes the logical op's name so
+                # Output-of-Unit dataflow edges keep resolving; pruning
+                # keeps every Save regardless (they are roots)
+                is_last = i == len(owners) - 1 and slot == 1
+                sess.add_operation(
+                    "Save",
+                    [key_name, sess._name_of(share)],
+                    owner,
+                    Signature((_STRING_TY, _ring_ty(width)), _UNIT_TY),
+                    {},
+                    name=op.name if is_last else f"{op.name}_p{i}s{slot}",
+                )
+                last = owner
+        return HostUnit(last)
+
+    # LoadShares: reassemble the replicated sharing from each party's
+    # own persisted pair; shape/precision are static op metadata
+    dtype = ret.dtype
+    if dtype is None or not dtype.is_fixedpoint:
+        raise CompilationError(
+            f"op {op.name}: LoadShares requires a fixed-point return "
+            f"dtype, found {dtype!r}"
+        )
+    shape = tuple(op.attributes["shape"])
+    width = 64 if dtype.name == "fixed64" else 128
+    shares = []
+    for i, owner in enumerate(comp.placements[plc.name].owners):
+        pair = []
+        for slot in (0, 1):
+            key_name = sess._string_const(share_key(key, slot), owner)
+            load_name = sess.add_operation(
+                "Load",
+                [key_name],
+                owner,
+                Signature((_STRING_TY,), _ring_ty(width)),
+                {},
+                name=f"{op.name}_p{i}s{slot}",
+            )
+            pair.append(sess._ring(load_name, shape, width, owner))
+        shares.append(tuple(pair))
+    rep_tensor = RepTensor(tuple(shares), plc.name)
+    return RepFixedTensor(
+        rep_tensor, dtype.integral_precision, dtype.fractional_precision
+    )
+
+
 def lower(comp: Computation, arg_specs: Optional[dict] = None) -> Computation:
     """Lower a logical computation to a host-level computation."""
     arg_specs = arg_specs or {}
@@ -304,15 +412,24 @@ def lower(comp: Computation, arg_specs: Optional[dict] = None) -> Computation:
             env[name] = HostUnit(plc.name)
             continue
 
+        if kind in ("SaveShares", "LoadShares"):
+            env[name] = _lower_shares_boundary(sess, comp, op, plc, env)
+            continue
+
         if kind == "Output":
             value = env[op.inputs[0]]
             if not isinstance(value, HostUnit):
                 value = logical.to_host(sess, plc.name, value)
             if isinstance(value, HostUnit):
                 # an Output of a Unit (e.g. after Save): keep the dataflow
-                # edge to the producing op so pruning retains it
+                # edge to the producing op so pruning retains it.  The
+                # Output lands on the unit's OWNER host — a composite
+                # placement name (an Output of SaveShares traced under
+                # the replicated context) is not executable in the host
+                # graph and would make the networking pass synthesize a
+                # rendezvous no worker ever serves
                 sess.add_operation(
-                    "Output", [op.inputs[0]], plc.name,
+                    "Output", [op.inputs[0]], value.plc,
                     Signature((_UNIT_TY,), _UNIT_TY),
                     dict(op.attributes), name=name,
                 )

@@ -241,6 +241,11 @@ OPERATORS = [
     "ShlDim", "TruncPr",
     # Mirrored operators
     "Demirror", "Mirror",
+    # Secret-shared checkpoint boundary (training): each party durably
+    # persists / reloads ITS OWN replicated share pair through its local
+    # storage — lowering expands these into per-owner ring-typed
+    # Load/Save ops, so the model state never exists in the clear
+    "LoadShares", "SaveShares",
     # Convolution / pooling (north-star extension — BASELINE.json configs
     # list encrypted ResNet-style inference; no reference counterpart)
     "Conv2D", "AvgPool2D", "MaxPool2D", "Im2Col",

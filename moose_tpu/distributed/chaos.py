@@ -32,6 +32,15 @@ fail_ping:0.3,kill_after_ops:40,party:carole
   its own abort fanout, exactly like a SIGKILL — raises.
 - ``party`` (name): scope all faults to one identity; unscoped chaos
   applies everywhere (each identity keeps its own op counter).
+- ``max_kills`` (int, default 1): lifetime cap on how many times one
+  identity dies.  The drop/dup schedules are self-healing across
+  sessions (decisions key on STABLE rendezvous keys with per-key
+  attempt counts, so an epoch resume under the same seed does not
+  re-trip the identical drop), but the kill op-budget is not — a
+  revived worker would die again at the same op count forever.  With
+  the cap, a restarted WorkerServer (``revive``) runs clean once the
+  budget is spent, so multi-session drivers (training epoch resume)
+  converge.  ``max_kills:0`` disables kills entirely.
 
 Transports are wrapped, not modified: :meth:`ChaosConfig.wrap` returns
 a :class:`ChaosNetworking` proxy composing over Local/Tcp/Grpc
@@ -88,7 +97,8 @@ class ChaosConfig:
                  delay_ms: float = 0.0, dup_send: float = 0.0,
                  fail_ping: float = 0.0,
                  kill_after_ops: Optional[int] = None,
-                 party: Optional[str] = None):
+                 party: Optional[str] = None,
+                 max_kills: Optional[int] = 1):
         self.seed = int(seed)
         self.drop_send = float(drop_send)
         self.delay_ms = float(delay_ms)
@@ -98,6 +108,15 @@ class ChaosConfig:
             None if kill_after_ops is None else int(kill_after_ops)
         )
         self.party = party
+        # cap on how many times ONE identity dies across the config's
+        # lifetime (None = unlimited).  Multi-session drivers (training
+        # epochs) need this: drop/dup schedules self-heal across
+        # sessions because they key on STABLE rendezvous keys with
+        # attempt counts, but the kill op-budget would otherwise
+        # re-trip on every revived worker forever and the supervisor
+        # could never converge.  Default 1 = the classic
+        # kill-once-stay-dead schedule until a revive.
+        self.max_kills = None if max_kills is None else int(max_kills)
         self._lock = threading.Lock()
         # per-rendezvous-key send attempts: retries under a fresh
         # session id land on count 1, 2, ... (session ids are random,
@@ -106,6 +125,7 @@ class ChaosConfig:
         self._ping_count: dict = {}
         self._ops: dict = {}  # identity -> networking op count
         self._killed: set = set()  # identities past their kill budget
+        self._kill_counts: dict = {}  # identity -> lifetime kill count
         self._kill_hooks: dict = {}  # identity -> callable
         self.faults: list = []  # injected-fault log, in schedule order
         _ACTIVE.add(self)
@@ -146,6 +166,8 @@ class ChaosConfig:
                     kwargs["delay_ms"] = float(raw)
                 elif key == "kill_after_ops":
                     kwargs["kill_after_ops"] = int(raw)
+                elif key == "max_kills":
+                    kwargs["max_kills"] = int(raw)
                 elif key == "party":
                     kwargs["party"] = raw
                 else:
@@ -215,10 +237,22 @@ class ChaosConfig:
                 raise NetworkingError(
                     f"chaos: {identity!r} killed (op budget exhausted)"
                 )
+            if (
+                self.max_kills is not None
+                and self._kill_counts.get(identity, 0) >= self.max_kills
+            ):
+                # kill budget for this identity is spent: a revived
+                # worker runs clean from here on, so a multi-session
+                # driver (epoch resume) converges instead of dying at
+                # the same op count forever
+                return
             n = self._ops.get(identity, 0) + 1
             self._ops[identity] = n
             if n > self.kill_after_ops:
                 self._killed.add(identity)
+                self._kill_counts[identity] = (
+                    self._kill_counts.get(identity, 0) + 1
+                )
                 self.faults.append({
                     "kind": "kill", "party": identity, "after_ops": n - 1,
                 })
@@ -234,6 +268,17 @@ class ChaosConfig:
             raise NetworkingError(
                 f"chaos: {identity!r} killed (op budget exhausted)"
             )
+
+    def revive(self, identity: str) -> None:
+        """A restarted worker is alive again: clear the killed latch
+        and the op counter (the kill-count survives, so ``max_kills``
+        bounds how often the schedule can strike).  WorkerServer.start
+        calls this — an in-process 'process restart' shares the config
+        object, and without the revive every transport op of the
+        restarted identity would keep raising forever."""
+        with self._lock:
+            self._killed.discard(identity)
+            self._ops.pop(identity, None)
 
     def check_alive(self, identity: str) -> None:
         with self._lock:

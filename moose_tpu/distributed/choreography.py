@@ -43,6 +43,7 @@ LAUNCH = "/moose.Choreography/LaunchComputation"
 RETRIEVE = "/moose.Choreography/RetrieveResults"
 ABORT = "/moose.Choreography/AbortComputation"
 FLIGHT = "/moose.Choreography/GetFlight"
+STORAGE_CONTROL = "/moose.Choreography/StorageControl"
 SEND_VALUE = "/moose.Networking/SendValue"
 ABORT_SESSION = "/moose.Networking/AbortSession"
 PING = "/moose.Networking/Ping"
@@ -404,6 +405,37 @@ class WorkerServer:
         )
         return _pack({"events": events})
 
+    def _storage_control(self, request: bytes, context=None) -> bytes:
+        """Checkpoint control plane for the training supervisor
+        (query / pin / commit / discard against this party's
+        CheckpointStore).  Choreographer-gated like launch/retrieve —
+        commit and pin decide which model generation this party serves.
+        Errors travel as typed wire envelopes so the driver re-raises
+        the real class (CheckpointError is non-retryable; a transport
+        failure reaching a dead worker classifies retryable at the
+        client)."""
+        self._check_choreographer(context)
+        msg = _unpack(request)
+        cmd = msg.get("cmd")
+        try:
+            store = self.storage
+            if not hasattr(store, "checkpoint_control"):
+                from ..errors import ConfigurationError
+
+                raise ConfigurationError(
+                    f"{self.identity}: storage has no checkpoint "
+                    "support (start the worker with a CheckpointStore "
+                    "— comet: --checkpoint)"
+                )
+            result = store.checkpoint_control(cmd, msg.get("args") or {})
+            return _pack({"ok": True, "result": result})
+        except Exception as e:  # noqa: BLE001 — typed envelope below
+            return _pack({
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+                "envelope": to_wire(e, self.identity),
+            })
+
     # bound on remembered aborted/completed ids (replay/late-send
     # protection); old entries age out FIFO so a long-lived worker's
     # state stays bounded
@@ -762,6 +794,7 @@ class WorkerServer:
             "RetrieveResults": unary(self._retrieve),
             "AbortComputation": unary(self._abort),
             "GetFlight": unary(self._get_flight),
+            "StorageControl": unary(self._storage_control),
         }
         net_handlers = {
             "SendValue": unary(self._send_value),
@@ -824,6 +857,16 @@ class WorkerServer:
                 )
             self.metrics_port = self.metrics_server.port
         self._server.start()
+        if self.chaos is not None:
+            # an in-process 'restart' constructs a fresh WorkerServer
+            # over the SAME chaos config: the restarted identity is
+            # alive again (its kill-count persists — max_kills bounds
+            # how often the schedule may strike it).  Revive only AFTER
+            # the server is actually serving: reviving before a failed
+            # bind would clear the _killed latch the restart watchdog
+            # iterates, so a transient bind error could never be
+            # retried
+            self.chaos.revive(self.identity)
         return self
 
     def stop(self, grace: float = 0.5):
@@ -867,6 +910,60 @@ def start_local_cluster(identities, storages=None, **server_kwargs):
         srv.endpoints.update(endpoints)
         srv.networking._endpoints.update(endpoints)
     return servers, endpoints
+
+
+def start_chaos_restarter(servers, endpoints, storages, chaos,
+                          restart_delay_s: float = 1.0,
+                          poll_s: float = 0.3, **server_kwargs):
+    """Test/bench harness: watch a chaos config and 'process-restart'
+    any killed in-process worker — stop the stale WorkerServer, rebind
+    a fresh one on the SAME port with the SAME (durable) storage and
+    the SAME chaos config (``start`` revives the identity; max_kills
+    bounds further strikes).  Returns a zero-arg stop callable.  The
+    single restart loop shared by tests/test_training.py and
+    bench.py's training bench, so restart semantics cannot drift."""
+    import time as _time
+
+    stop_event = threading.Event()
+
+    def loop():
+        from ..logger import get_logger
+
+        while not stop_event.is_set():
+            _time.sleep(poll_s)
+            if chaos is None:
+                continue
+            for party in list(chaos._killed):
+                # a failed restart (port raced by another process,
+                # transient bind error) must NOT kill this watcher
+                # thread — the identity would stay latched dead and the
+                # driver's failure would point at the wrong culprit;
+                # log and retry on the next poll
+                try:
+                    _time.sleep(restart_delay_s)
+                    old = servers[party]
+                    old.stop(grace=0)
+                    srv = WorkerServer(
+                        party, old.port, dict(endpoints),
+                        storage=(storages or {}).get(party),
+                        chaos=chaos, **server_kwargs,
+                    )
+                    srv.start()
+                    servers[party] = srv
+                except Exception:  # noqa: BLE001 — retried next poll
+                    get_logger().warning(
+                        "chaos restarter: restart of %r failed; "
+                        "retrying", party, exc_info=True,
+                    )
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+
+    def stop():
+        stop_event.set()
+        thread.join(timeout=3.0)
+
+    return stop
 
 
 def _serialize_output(value) -> bytes:
@@ -939,3 +1036,23 @@ class ChoreographyClient:
             "session_ids": list(session_ids), "limit": limit,
         })
         return _unpack(fn(payload, timeout=timeout)).get("events", [])
+
+    def storage_control(self, cmd: str, args: Optional[dict] = None,
+                        timeout: float = 30.0):
+        """Drive the worker's CheckpointStore (training control plane).
+        Wire-envelope errors re-raise as their real class — a
+        CheckpointError on the worker is a CheckpointError here."""
+        fn = self._channel.unary_unary(STORAGE_CONTROL)
+        resp = _unpack(fn(
+            _pack({"cmd": cmd, "args": args or {}}), timeout=timeout,
+        ))
+        if not resp.get("ok"):
+            from ..errors import from_wire
+
+            envelope = resp.get("envelope")
+            if envelope:
+                raise from_wire(envelope)
+            raise NetworkingError(
+                f"storage_control({cmd}) failed: {resp.get('error')}"
+            )
+        return resp.get("result")

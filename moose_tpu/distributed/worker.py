@@ -162,7 +162,9 @@ def _exec_host_op(op, env: dict, identity: str, arguments: dict,
                 f"Save {op.name}: key must be a string, found "
                 f"{type(key).__name__}"
             )
-        storage[key.value] = _to_user_value(env[op.inputs[1]])
+        from ..execution.interpreter import _save_user_value
+
+        storage[key.value] = _save_user_value(env[op.inputs[1]])
         return HostUnit(identity)
     if kind == "Output":
         value = env[op.inputs[0]]

@@ -708,6 +708,46 @@ def save(key, value, placement=None):
     return _expr("Save", [key, value], {}, placement, ty.UnitType())
 
 
+def load_shares(key, shape, dtype, placement=None):
+    """Reload a secret-shared tensor persisted with :func:`save_shares`.
+
+    Placed on a replicated placement: lowering expands this into two
+    ring-typed ``Load`` ops per party (each party reads back the share
+    pair it saved from its OWN storage), reassembled as the replicated
+    sharing — the value is never reconstructed in the clear anywhere.
+    ``shape`` must be static (XLA) and ``dtype`` a fixed-point dtype;
+    ``key`` must be a string constant so checkpoint keys stay stable
+    across epochs (compiled-plan caches key on the computation bytes).
+    """
+    placement = _materialize_placement_arg(placement)
+    if not isinstance(dtype, dt.DType) or not dtype.is_fixedpoint:
+        raise ValueError(
+            f"load_shares requires a fixed-point dtype, found {dtype!r}"
+        )
+    if isinstance(key, str):
+        key = constant(key, placement=placement)
+    return _expr(
+        "LoadShares",
+        [key],
+        {"shape": tuple(int(s) for s in shape)},
+        placement,
+        ty.TensorType(dtype),
+    )
+
+
+def save_shares(key, value, placement=None):
+    """Durably persist a replicated value AS SHARES: lowering expands
+    this into two ring-typed ``Save`` ops per party, so each party
+    writes exactly the share pair it already holds to its own storage
+    and no party (or the client) ever sees the plaintext.  The inverse
+    of :func:`load_shares`; the training checkpoint protocol
+    (``moose_tpu.training``) builds on this pair."""
+    placement = _materialize_placement_arg(placement)
+    if isinstance(key, str):
+        key = constant(key, placement=placement)
+    return _expr("SaveShares", [key, value], {}, placement, ty.UnitType())
+
+
 def output(tag, value, placement=None):
     placement = _materialize_placement_arg(placement)
     return _expr("Output", [value], {"tag": tag}, placement, value.vtype)

@@ -114,6 +114,15 @@ class ReplicaDrainingError(MooseError):
     header."""
 
 
+class CheckpointError(StorageError):
+    """A secret-shared training checkpoint was rejected: torn commit,
+    checksum/tamper mismatch, stale or missing generation, format or
+    fixed-keys discipline mismatch.  NON-retryable — replaying the same
+    session against the same bad checkpoint deterministically fails;
+    the training supervisor instead falls back to the previous valid
+    generation (or surfaces the error when none exists)."""
+
+
 class SnapshotError(MooseError):
     """A warm-state snapshot could not be written, or an on-disk
     snapshot failed validation at load time (format-version skew,

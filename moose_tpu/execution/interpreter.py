@@ -173,7 +173,7 @@ def build_plan(comp: Computation, arguments: dict, use_jit: bool,
                 static_env[name] = HostString(value, plc.name)
             elif op.signature.return_type.name in ("HostInt", "HostFloat"):
                 static_env[name] = value
-        elif op.kind == "Load":
+        elif op.kind in ("Load", "LoadShares"):
             dynamic_names.append(name)
 
     if any(
@@ -249,6 +249,20 @@ def _run_ops(sess, comp, names, static_env, env, outputs, saves, dyn,
                 )
             else:
                 env[name] = _lift_array(arr, op, plc.name)
+            continue
+        if op.kind == "LoadShares":
+            env[name] = _lift_shares(dyn[name], op, plc)
+            continue
+        if op.kind == "SaveShares":
+            key = env[op.inputs[0]]
+            assert isinstance(key, HostString), (
+                f"SaveShares key must be a string, found "
+                f"{type(key).__name__}"
+            )
+            _stage_shares(
+                sess, dialect, plc, key.value, env[op.inputs[1]], saves
+            )
+            env[name] = HostUnit(plc.owners[-1])
             continue
         if op.kind == "Save":
             key = env[op.inputs[0]]
@@ -1328,12 +1342,73 @@ class _DeviceCache:
 _device_cache = _DeviceCache()
 
 
+def _save_user_value(value):
+    """Storage form of a Save'd runtime value: ring tensors persist as
+    uint64 limb planes (lossless through ``.npy``; ``to_numpy``'s
+    object-int form is not) — the SaveShares/LoadShares round-trip —
+    everything else keeps the user-facing conversion."""
+    from ..values import HostRingTensor, ring_to_limbs
+
+    if isinstance(value, HostRingTensor):
+        return np.asarray(ring_to_limbs(value))
+    return _to_user_value(value)
+
+
+def _lift_shares(arrs, op, plc):
+    """Reassemble a replicated sharing from the six party-held limb
+    arrays of a LoadShares binding (party-major, slot-minor)."""
+    from ..values import RepFixedTensor, RepTensor, limbs_to_ring
+
+    dtype = op.signature.return_type.dtype
+    width = 64 if dtype.name == "fixed64" else 128
+    it = iter(arrs)
+    shares = tuple(
+        tuple(limbs_to_ring(next(it), width, owner) for _ in range(2))
+        for owner in plc.owners
+    )
+    return RepFixedTensor(
+        RepTensor(shares, plc.name),
+        dtype.integral_precision,
+        dtype.fractional_precision,
+    )
+
+
+def _stage_shares(sess, dialect, plc, key: str, value, saves) -> None:
+    """Stage a SaveShares op: each party's two held ring tensors land in
+    ``saves`` under that party's OWN (owner, key) slots — the plaintext
+    is never reconstructed."""
+    from ..compilation.lowering import _shares_of, share_key
+    from ..dialects import logical as _logical
+
+    if dialect is not _logical:
+        from ..errors import TypeMismatchError
+
+        raise TypeMismatchError(
+            "SaveShares/LoadShares run on the per-host backends only"
+        )
+    rep = _logical.to_rep(sess, plc, value)
+    rep_tensor, _, _ = _shares_of(rep)
+    for i, owner in enumerate(plc.owners):
+        for slot in (0, 1):
+            saves[(owner, share_key(key, slot))] = (
+                rep_tensor.shares[i][slot]
+            )
+
+
 def _lift_array(arr, op, plc_name: str):
     """Bind a host-boundary array (possibly a jit tracer) as a runtime
     value."""
     import jax.numpy as jnp
 
     ret = op.signature.return_type
+    if ret.name in ("HostRing64Tensor", "HostRing128Tensor"):
+        # ring-typed boundary (secret-shared checkpoints): storage holds
+        # uint64 limb planes — see values.ring_to_limbs
+        from ..values import limbs_to_ring
+
+        return limbs_to_ring(
+            arr, 64 if ret.name == "HostRing64Tensor" else 128, plc_name
+        )
     dtype = ret.dtype
     if dtype is not None and dtype.is_fixedpoint:
         raise ValueError(
@@ -1461,6 +1536,28 @@ class Interpreter:
                     if not isinstance(val, np.ndarray):
                         val = np.asarray(val)
                     dyn[name] = _device_cache.put(val)
+                elif op.kind == "LoadShares":
+                    # each party's own persisted share pair, read from
+                    # that party's OWN storage (party-major order, the
+                    # _lift_shares convention)
+                    from ..compilation.lowering import share_key
+
+                    key = self._resolve_load_key(plan, comp, op, arguments)
+                    arrs = []
+                    for owner in plc.owners:
+                        store = storage.get(owner, {})
+                        for slot in (0, 1):
+                            skey = share_key(key, slot)
+                            if skey not in store:
+                                raise KeyError(
+                                    f"no value for key {skey!r} in "
+                                    f"storage of {owner!r}"
+                                )
+                            val = store[skey]
+                            if not isinstance(val, np.ndarray):
+                                val = np.asarray(val)
+                            arrs.append(_device_cache.put(val))
+                    dyn[name] = tuple(arrs)
                 else:  # Load
                     key = self._resolve_load_key(plan, comp, op, arguments)
                     store = storage.get(plc.name, {})
@@ -1505,7 +1602,7 @@ class Interpreter:
             ):
                 for (plc_name, key), value in saves.items():
                     storage.setdefault(plc_name, {})[key] = (
-                        _to_user_value(value)
+                        _save_user_value(value)
                     )
                 return {
                     name: _to_user_value(outputs[name])

@@ -679,6 +679,7 @@ class PhysicalInterpreter:
             sp.attrs["pinned_ops"] = len(info["pinned_ops"])
 
         from .interpreter import (
+            _save_user_value,
             _to_user_value,
             ordered_output_names,
             prefetch_to_host,
@@ -689,7 +690,7 @@ class PhysicalInterpreter:
         # tunneled setups — BENCH_r05 result_to_host_latency_s)
         prefetch_to_host(outputs, saves)
         for (plc_name, key), value in saves.items():
-            storage.setdefault(plc_name, {})[key] = _to_user_value(value)
+            storage.setdefault(plc_name, {})[key] = _save_user_value(value)
         return {
             name: _to_user_value(outputs[name])
             for name in ordered_output_names(outputs)

@@ -8,6 +8,7 @@ from . import neural_network_predictor
 from . import onnx_proto
 from . import predictor
 from . import predictor_utils
+from . import trainers
 from . import tree_ensemble
 from .convnet_predictor import ConvNet
 from .linear_predictor import LinearClassifier, LinearRegressor
@@ -15,6 +16,7 @@ from .multilayer_perceptron_predictor import MLPClassifier, MLPRegressor
 from .neural_network_predictor import NeuralNetwork
 from .onnx_convert import from_onnx
 from .predictor import AesWrapper, Predictor
+from .trainers import LogregSGDTrainer, MLPSGDTrainer, SecureTrainer
 from .tree_ensemble import (
     DecisionTreeRegressor,
     TreeEnsembleClassifier,
@@ -41,6 +43,10 @@ __all__ = [
     "onnx_proto",
     "predictor",
     "predictor_utils",
+    "LogregSGDTrainer",
+    "MLPSGDTrainer",
+    "SecureTrainer",
+    "trainers",
     "tree_ensemble",
 ]
 

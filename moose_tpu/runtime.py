@@ -86,8 +86,17 @@ class LocalMooseRuntime:
                     f"must be one of {identities}"
                 )
         self.identities = list(identities)
+        # plain dicts are defensively copied; storage OBJECTS
+        # (FilesystemStorage, training.CheckpointStore — anything with a
+        # .load) are kept as-is, the runtime reads/writes through their
+        # protocol
         self.storage = {
-            identity: dict(storage_mapping.get(identity, {}))
+            identity: (
+                store
+                if hasattr(store := storage_mapping.get(identity, {}),
+                           "load")
+                else dict(store)
+            )
             for identity in identities
         }
         import weakref

@@ -273,6 +273,11 @@ class ModelQueue:
             return batch
 
     def _dispatch(self, batch) -> None:
+        # ONE model snapshot for the whole batch: a hot-swap
+        # (server.replace_model) flips model between any two
+        # reads, and a batch padded against one RegisteredModel must be
+        # evaluated against the SAME one (its buckets, its warm plans)
+        model = self.model
         # deadline admission: expired requests complete exceptionally
         # and never occupy batch rows
         now = time.perf_counter()
@@ -287,7 +292,7 @@ class ModelQueue:
                 self.metrics.record_deadline_drop()
                 request.future.set_exception(
                     DeadlineExceededError(
-                        f"model {self.model.name!r}: deadline expired "
+                        f"model {model.name!r}: deadline expired "
                         "after "
                         f"{(now - request.enqueued_s) * 1e3:.1f} ms in "
                         "queue; request was not evaluated"
@@ -307,25 +312,25 @@ class ModelQueue:
             self.metrics.record_queue_wait(now - request.enqueued_s)
             profiling.record_complete(
                 "serve_queue_wait", request.enqueued_s, now,
-                model=self.model.name,
+                model=model.name,
             )
         with telemetry.span(
             "serve_batch",
-            model=self.model.name,
+            model=model.name,
             queue_depth=self.depth(),
         ) as sp:
             try:
                 rows = np.concatenate([r.rows for r in live], axis=0)
-                padded, bucket = self.model.pad(rows)
+                padded, bucket = model.pad(rows)
                 sp.attrs["rows"] = int(rows.shape[0])
                 sp.attrs["bucket"] = int(bucket)
                 t_compute = time.perf_counter()
                 with profiling.phase(
-                    "serve_compute", model=self.model.name,
+                    "serve_compute", model=model.name,
                     bucket=int(bucket),
                 ):
                     result, report = self.registry.evaluate(
-                        self.model, padded
+                        model, padded
                     )
                     profiling.fence(result)
                 compute_s = time.perf_counter() - t_compute
@@ -364,7 +369,7 @@ class ModelQueue:
                 # counted as a miss in telemetry)
                 request.future.set_exception(
                     DeadlineExceededError(
-                        f"model {self.model.name!r}: result ready "
+                        f"model {model.name!r}: result ready "
                         f"{(done - request.deadline_s) * 1e3:.1f} ms "
                         "past the deadline"
                     )

@@ -187,6 +187,66 @@ class ModelRegistry:
         self._models[name] = registered
         return registered
 
+    def replace(
+        self,
+        name: str,
+        model,
+        row_shape: Tuple[int, ...],
+        **kwargs,
+    ) -> RegisteredModel:
+        """Warm hot-swap of a live model (the training export path): the
+        replacement traces/compiles/ladder-validates under a staging
+        name while the OLD version keeps serving every request, then
+        one dict assignment flips the name — in-flight batches against
+        the old ``RegisteredModel`` finish on its still-cached plans,
+        so nothing is dropped and nothing ever serves cold."""
+        import dataclasses as _dc
+
+        if name not in self._models:
+            raise ConfigurationError(
+                f"model {name!r} is not registered (use register)"
+            )
+        old = self._models[name]
+        # inherit the live registration's bucket ladder when the caller
+        # doesn't override it: requests already ADMITTED against the
+        # old buckets must still fit the replacement's largest bucket,
+        # or a queued batch would fail at pad() after the swap.
+        # (fixedpoint_dtype is not recoverable from the old model —
+        # callers serving a non-default dtype must re-pass it.)
+        if not kwargs.get("buckets"):
+            kwargs["buckets"] = old.buckets
+        elif max(kwargs["buckets"]) < old.buckets[-1]:
+            # a SHRINKING largest bucket would strand any queued
+            # request admitted against the old ladder: _gather could
+            # never pop it and it would head-of-line-block the queue
+            # forever
+            raise ConfigurationError(
+                f"replace({name!r}): largest bucket "
+                f"{max(kwargs['buckets'])} < live {old.buckets[-1]}; "
+                "hot-swap buckets must cover every admissible request"
+            )
+        if tuple(row_shape) != old.row_shape:
+            # the batcher admits requests against one row_shape and
+            # evaluates them (one model snapshot per batch) possibly
+            # after the swap: a shape-changing replacement would fail
+            # already-queued rows.  A different shape is a NEW model —
+            # register it under a new name and cut traffic over
+            raise ConfigurationError(
+                f"replace({name!r}): row_shape {tuple(row_shape)} != "
+                f"live {old.row_shape}; hot-swap requires an "
+                "identical input shape"
+            )
+        staging = f"__staging__/{name}"
+        while staging in self._models:
+            staging += "+"
+        registered = self.register(
+            staging, model, row_shape=row_shape, **kwargs
+        )
+        del self._models[staging]
+        registered = _dc.replace(registered, name=name)
+        self._models[name] = registered
+        return registered
+
     def evaluate(self, model: RegisteredModel, batch: np.ndarray):
         """One warm evaluation of a full (already padded) bucket.
         Returns (per-row outputs, eval_report) where the report carries

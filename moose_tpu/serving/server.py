@@ -75,6 +75,36 @@ class InferenceServer:
         )
         return registered
 
+    def replace_model(
+        self,
+        name: str,
+        model,
+        row_shape: Tuple[int, ...],
+        buckets: Tuple[int, ...] = (),
+        fixedpoint_dtype=None,
+        input_name: Optional[str] = None,
+    ):
+        """Hot-swap a live model with ZERO dropped requests: the
+        replacement warms fully under the registry's staging name while
+        the old version answers everything, then the queue's model
+        reference flips atomically — in-flight batches finish against
+        the old object (its plans stay cached), new batches bucket
+        against the new one."""
+        if self._closed:
+            raise ConfigurationError("server is shut down")
+        registered = self.registry.replace(
+            name,
+            model,
+            row_shape=row_shape,
+            buckets=buckets,
+            fixedpoint_dtype=fixedpoint_dtype,
+            input_name=input_name,
+        )
+        queue = self._queues.get(name)
+        if queue is not None:
+            queue.model = registered
+        return registered
+
     def load_snapshot(self, directory, source_digests=None,
                       rewarm: bool = True) -> dict:
         """Restore every model from the live warm-state snapshot under

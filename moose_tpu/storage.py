@@ -73,8 +73,10 @@ class FilesystemStorage:
         # load).  The temp file lives in the SAME directory so
         # os.replace stays an atomic same-filesystem rename.
         target = self._path(key, ".npy")
+        # hierarchical keys ("ckpt/gen-0/model#s0") map to subdirectories
+        target.parent.mkdir(parents=True, exist_ok=True)
         tmp = tempfile.NamedTemporaryFile(
-            dir=self.root, prefix=target.name + ".", suffix=".tmp",
+            dir=target.parent, prefix=target.name + ".", suffix=".tmp",
             delete=False,
         )
         try:
@@ -88,6 +90,55 @@ class FilesystemStorage:
             with contextlib.suppress(OSError):
                 os.unlink(tmp.name)
             raise
+
+    def list_keys(self, prefix: str = "") -> list:
+        """Keys under ``prefix``, sorted.  The storage-level enumeration
+        checkpoint retention/GC and resume discovery build on — callers
+        never walk the filesystem behind the abstraction's back."""
+        # walk only the subtree the prefix pins down: checkpoint
+        # control calls enumerate '_ckpt/...' many times per epoch and
+        # must not pay a recursive scan of every unrelated dataset
+        # file in the store
+        base = self.root
+        head, _, _ = prefix.rpartition("/")
+        if head:
+            candidate = base / head
+            if not candidate.exists():
+                return []
+            base = candidate
+        keys = []
+        for path in base.rglob("*"):
+            if not path.is_file() or path.suffix not in (".npy", ".csv"):
+                continue
+            key = str(path.relative_to(self.root))[: -len(path.suffix)]
+            if key.startswith(prefix):
+                keys.append(key)
+        return sorted(keys)
+
+    def delete(self, key: str) -> None:
+        """Remove a key (both representations); missing keys are a
+        typed :class:`StorageError`, matching :meth:`load`.  Emptied
+        parent directories (auto-created by hierarchical-key saves) are
+        pruned back up to the root, so checkpoint generation GC does
+        not leak one directory tree per pruned generation."""
+        found = False
+        for suffix in (".npy", ".csv"):
+            path = self._path(key, suffix)
+            if path.exists():
+                path.unlink()
+                found = True
+                parent = path.parent
+                root = self.root.resolve()
+                while parent.resolve() != root:
+                    try:
+                        parent.rmdir()  # only succeeds when empty
+                    except OSError:
+                        break
+                    parent = parent.parent
+        if not found:
+            raise StorageError(
+                f"no value for key {key!r} in {self.root}"
+            )
 
     def _load_csv(self, path: Path, query: str):
         """Load a csv as float64 columns; ``query`` is the reference's

@@ -1,0 +1,31 @@
+"""Secure training as a first-class workload (ROADMAP item 3).
+
+A multi-epoch MPC training run is a LONG-LIVED distributed session
+sequence — long enough that a worker *will* die mid-epoch — so this
+package turns the PR-3 fault-tolerance stack (retrying supervisor,
+typed wire errors, chaos layer) into load-bearing infrastructure:
+
+- :mod:`.checkpoint` — each party durably persists ITS OWN replicated
+  share pair of the model state (atomic tempfile + ``os.replace``
+  writes, checksum-validated manifests, CURRENT-pointer generations
+  reusing the PR-9 snapshot discipline, bounded retention).  The model
+  never exists in the clear on any host, on the wire, or at the client.
+- :mod:`.session` — the epoch supervisor: runs N epochs as successive
+  distributed sessions layered on the PR-3 client supervisor, commits a
+  checkpoint generation per epoch (two-phase: stage in-graph via
+  ``SaveShares``, commit via the StorageControl rpc after the session
+  succeeds), and on a retryable mid-epoch failure resumes from the last
+  committed generation under a fresh session id — never replaying a
+  committed epoch, never serving a torn checkpoint, bit-exact under
+  ``MOOSE_TPU_FIXED_KEYS``.
+- :mod:`.export` — reveal + register: a finished model exports to ONNX
+  and hot-swaps into the PR-4 serving registry with zero dropped
+  requests (in-process via ``ModelRegistry.replace``; across processes
+  via the PR-9 snapshot/drain path).
+
+The SGD-step graphs themselves live with the model zoo:
+:mod:`moose_tpu.predictors.trainers`.
+"""
+
+from .checkpoint import CKPT_FORMAT, CheckpointStore  # noqa: F401
+from .session import TrainingConfig, TrainingSession  # noqa: F401

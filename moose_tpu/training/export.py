@@ -1,0 +1,64 @@
+"""Trained-model export: reveal -> ONNX -> serving hot-swap.
+
+The last leg of the training story (ROADMAP item 3): the weights a
+:class:`~moose_tpu.training.session.TrainingSession` revealed to the
+model receiver become a standard predictor artifact and replace the
+live version in the PR-4 serving registry —
+
+- in-process: :func:`hot_swap` drives
+  ``InferenceServer.replace_model`` (warm staging registration, atomic
+  queue flip, zero dropped requests);
+- across processes (a running blitzen): write the ONNX artifact over
+  the daemon's model file and roll it through the PR-9 snapshot/drain
+  path — SIGTERM drains in-flight batches and re-snapshots, the
+  restart invalidates the snapshot on the model-source digest change
+  and registers the new weights fresh (``scripts/train_smoke.py``
+  exercises exactly this, asserting zero dropped requests).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+
+from ..predictors import sklearn_export
+
+
+def logreg_onnx_bytes(weights: np.ndarray,
+                      intercept: np.ndarray = None) -> bytes:
+    """Serialize trained logistic-regression weights as a
+    skl2onnx-layout LinearClassifier ONNX model (binary: both class
+    rows, LOGISTIC post-transform) — importable by ``from_onnx`` and
+    servable by blitzen.  ``weights`` is the trainer's (n_features, 1)
+    column; intercept defaults to zero (the SGD trainers are
+    bias-free)."""
+    w = np.asarray(weights, dtype=np.float64).reshape(-1)
+    shim = SimpleNamespace(
+        coef_=w[None, :],
+        intercept_=np.zeros(1) if intercept is None else (
+            np.asarray(intercept, dtype=np.float64).reshape(1)
+        ),
+        classes_=np.array([0, 1]),
+    )
+    return sklearn_export.logistic_regression_onnx(
+        shim, n_features=w.shape[0]
+    ).encode()
+
+
+def trained_predictor(weights: np.ndarray, intercept: np.ndarray = None):
+    """A ``predictors`` instance for the trained logreg weights (the
+    object form of :func:`logreg_onnx_bytes`)."""
+    from ..predictors import from_onnx
+
+    return from_onnx(logreg_onnx_bytes(weights, intercept))
+
+
+def hot_swap(server, name: str, weights: np.ndarray,
+             intercept: np.ndarray = None):
+    """Replace the live model ``name`` on an in-process
+    ``InferenceServer`` with freshly trained weights, zero requests
+    dropped (see ``InferenceServer.replace_model``)."""
+    model = trained_predictor(weights, intercept)
+    n_features = np.asarray(weights).reshape(-1).shape[0]
+    return server.replace_model(name, model, row_shape=(n_features,))

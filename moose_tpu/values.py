@@ -374,6 +374,36 @@ def host_tensor_from_numpy(arr: np.ndarray, plc: str) -> HostTensor | HostBitTen
     return HostTensor(arr, plc, dt.from_numpy(arr.dtype))
 
 
+def ring_to_limbs(value: HostRingTensor):
+    """Persistence form of a ring tensor: uint64 limb planes with a
+    leading limb axis — ``(1, *shape)`` for ring64, ``(2, *shape)``
+    (lo, hi) for ring128.  Unlike :func:`to_numpy`'s object-int form
+    this round-trips through ``.npy`` storage losslessly, which is what
+    secret-shared checkpoints (``SaveShares``/``LoadShares``) need."""
+    import jax.numpy as jnp
+
+    limbs = [value.lo] if value.width == 64 else [value.lo, value.hi]
+    return jnp.stack([jnp.asarray(l).astype(jnp.uint64) for l in limbs])
+
+
+def limbs_to_ring(arr, width: int, plc: str) -> HostRingTensor:
+    """Inverse of :func:`ring_to_limbs`: lift a ``(n_limbs, *shape)``
+    uint64 array back into a :class:`HostRingTensor` of ``width``."""
+    import jax.numpy as jnp
+
+    want = 1 if width == 64 else 2
+    arr = jnp.asarray(arr)
+    if arr.ndim < 1 or arr.shape[0] != want:
+        raise ValueError(
+            f"ring{width} limb array needs leading axis {want}, found "
+            f"shape {tuple(arr.shape)}"
+        )
+    arr = arr.astype(jnp.uint64)
+    return HostRingTensor(
+        arr[0], arr[1] if width == 128 else None, width, plc
+    )
+
+
 def to_numpy(value) -> np.ndarray:
     """Convert a host-level runtime value back to numpy for the user."""
     if isinstance(value, HostTensor):

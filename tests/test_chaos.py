@@ -652,3 +652,79 @@ def test_retryable_launch_failure_is_retried_to_success():
     finally:
         _stop_cluster(servers)
         _stop_cluster(late)
+
+
+# ---------------------------------------------------------------------------
+# multi-session composition (ISSUE 13 satellite): the fault schedule must
+# not starve a long-lived driver that runs MANY sessions over one config
+# ---------------------------------------------------------------------------
+
+
+class _NullTransport:
+    """Minimal transport for schedule-only chaos tests."""
+
+    def send(self, value, receiver, rendezvous_key, session_id, **kw):
+        return None
+
+    def ping(self, receiver, **kw):
+        return {"ok": True}
+
+
+def test_drop_schedule_is_per_attempt_not_per_session():
+    """drop_send keys on the STABLE rendezvous key with an attempt
+    count: a resumed session (fresh session id, same graph, same keys)
+    re-sends at attempt >= 1 and always goes through — the same seed
+    can never re-trip the identical drop forever."""
+    cfg = ChaosConfig(seed=3, drop_send=1.0)
+    net = cfg.wrap(_NullTransport(), "alice")
+    net.send(b"v", "bob", "rdv-0", "session-a")
+    first = [f for f in cfg.faults if f["kind"] == "drop_send"]
+    assert len(first) == 1  # probability 1.0: the first attempt drops
+    for session in ("session-b", "session-c"):
+        net.send(b"v", "bob", "rdv-0", session)
+    again = [f for f in cfg.faults if f["kind"] == "drop_send"]
+    assert len(again) == 1  # later attempts NEVER drop, any session id
+
+
+def test_kill_budget_caps_at_max_kills_and_revive_restores():
+    """kill_after_ops latches an identity dead; ``revive`` (what a
+    restarted WorkerServer calls) brings it back, and ``max_kills``
+    bounds how many times the schedule may strike — so an epoch-resume
+    driver converges instead of dying at the same op count forever."""
+    cfg = ChaosConfig(seed=5, kill_after_ops=2, party="alice",
+                      max_kills=1)
+    net = cfg.wrap(_NullTransport(), "alice")
+    net.send(b"v", "bob", "k0", "s")
+    net.send(b"v", "bob", "k1", "s")
+    with pytest.raises(NetworkingError):  # op 3 exceeds the budget
+        net.send(b"v", "bob", "k2", "s")
+    with pytest.raises(NetworkingError):  # latched dead
+        net.send(b"v", "bob", "k3", "s")
+
+    cfg.revive("alice")
+    for i in range(10):  # kill budget spent: runs clean forever
+        net.send(b"v", "bob", f"post-{i}", "s")
+    assert len([f for f in cfg.faults if f["kind"] == "kill"]) == 1
+
+    # max_kills=2 strikes again after a revive, then stays clean
+    cfg2 = ChaosConfig(seed=5, kill_after_ops=1, party="alice",
+                       max_kills=2)
+    net2 = cfg2.wrap(_NullTransport(), "alice")
+    net2.send(b"v", "bob", "a", "s")
+    with pytest.raises(NetworkingError):
+        net2.send(b"v", "bob", "b", "s")
+    cfg2.revive("alice")
+    net2.send(b"v", "bob", "c", "s")
+    with pytest.raises(NetworkingError):
+        net2.send(b"v", "bob", "d", "s")
+    cfg2.revive("alice")
+    for i in range(5):
+        net2.send(b"v", "bob", f"e{i}", "s")
+    assert len([f for f in cfg2.faults if f["kind"] == "kill"]) == 2
+
+
+def test_chaos_env_parses_max_kills():
+    cfg = ChaosConfig.from_env("seed:1,kill_after_ops:5,max_kills:3")
+    assert cfg.kill_after_ops == 5 and cfg.max_kills == 3
+    # default preserves the classic kill-once schedule
+    assert ChaosConfig.from_env("seed:1,kill_after_ops:5").max_kills == 1

@@ -70,3 +70,57 @@ def test_object_dtype_still_rejected_before_any_write(tmp_path):
     with pytest.raises(StorageError):
         storage.save("bad", np.array([object()]))
     assert not list(tmp_path.iterdir())
+
+
+def test_list_keys_and_delete(tmp_path):
+    """ISSUE 13 satellite: enumeration + deletion live ON the storage
+    abstraction, so checkpoint retention/GC and resume discovery never
+    walk the filesystem behind its back."""
+    storage = FilesystemStorage(str(tmp_path))
+    storage.save("ckpt/gen-0/model#s0", np.zeros(2))
+    storage.save("ckpt/gen-0/model#s1", np.ones(2))
+    storage.save("ckpt/gen-1/model#s0", np.ones(2))
+    storage.save("other", np.ones(1))
+
+    assert storage.list_keys() == [
+        "ckpt/gen-0/model#s0", "ckpt/gen-0/model#s1",
+        "ckpt/gen-1/model#s0", "other",
+    ]
+    assert storage.list_keys("ckpt/gen-0/") == [
+        "ckpt/gen-0/model#s0", "ckpt/gen-0/model#s1",
+    ]
+
+    storage.delete("ckpt/gen-0/model#s0")
+    assert "ckpt/gen-0/model#s0" not in storage
+    assert storage.list_keys("ckpt/gen-0/") == ["ckpt/gen-0/model#s1"]
+    with pytest.raises(StorageError):
+        storage.delete("ckpt/gen-0/model#s0")
+
+
+def test_hierarchical_key_save_is_atomic(tmp_path, monkeypatch):
+    """Nested (checkpoint-style) keys keep the tempfile+replace
+    discipline: the temp file lives in the TARGET's directory."""
+    storage = FilesystemStorage(str(tmp_path))
+    storage.save("ckpt/gen-0/w", np.arange(3.0))
+    np.testing.assert_array_equal(
+        storage.load("ckpt/gen-0/w"), np.arange(3.0)
+    )
+
+    real_save = np.save
+
+    def exploding_save(file, arr, **kwargs):
+        file.write(b"\x93NUMPY-truncated")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "save", exploding_save)
+    with pytest.raises(OSError):
+        storage.save("ckpt/gen-0/w", np.zeros(5))
+    monkeypatch.setattr(np, "save", real_save)
+    np.testing.assert_array_equal(
+        storage.load("ckpt/gen-0/w"), np.arange(3.0)
+    )
+    leftovers = [
+        p for p in (tmp_path / "ckpt" / "gen-0").iterdir()
+        if p.suffix == ".tmp"
+    ]
+    assert not leftovers
