@@ -3,7 +3,8 @@
 Runs the graph analyses of :mod:`moose_tpu.compilation.analysis` —
 secrecy/information-flow (MSA1xx), communication pairing/deadlock
 (MSA2xx), signature consistency (MSA3xx), graph hygiene (MSA4xx),
-execution-plan schedule (MSA5xx), communication/memory cost (MSA6xx) —
+execution-plan schedule (MSA5xx), communication/memory cost (MSA6xx),
+fixed-point value ranges (MSA7xx) —
 over one or more computation files (textual ``.moose`` or msgpack, like
 the rest of the reindeer tool family) and reports every finding.  Exit
 status is 1 if any error-severity diagnostic fired (add
@@ -21,12 +22,25 @@ infer; ``--session-id`` sets the id whose length prices the transfer
 keys (byte counts depend only on its length; the client mints
 32-hex-char ids, the default).
 
+``--ranges`` emits the MSA7xx per-value precision report (fixed-point
+intervals, raw-bit demand, minimal ring width).  ``--arg-range
+name=-1:1`` declares a real-space input bound (repeatable; keyed by
+Input name or Load/LoadShares storage key) — declared bounds are what
+arm the MSA701/702 overflow errors; without them the analysis only
+reports representable-interval worst cases.  ``--margin-bits`` tunes
+the MSA702 thin-headroom threshold; ``--jumbo-bytes`` /
+``--live-buffer-bytes`` tune the MSA602/MSA603 cost note thresholds
+(env: ``MOOSE_TPU_LINT_MARGIN_BITS``, ``MOOSE_TPU_LINT_JUMBO_BYTES``,
+``MOOSE_TPU_LINT_LIVE_BUFFER_BYTES``).
+
 Examples:
   python -m moose_tpu.bin.prancer comp.moose
   python -m moose_tpu.bin.prancer lowered.bin --analyses communication,hygiene
   python -m moose_tpu.bin.prancer comp.moose --passes typing,prune --format json
   python -m moose_tpu.bin.prancer lowered.bin --schedule --cost --role alice \
       --format json
+  python -m moose_tpu.bin.prancer comp.moose --ranges \
+      --arg-shape x=16x4 --arg-range x=-1:1 --arg-range w=-2:2
   python -m moose_tpu.bin.prancer --explain          # rule catalogue
 """
 
@@ -58,6 +72,52 @@ def _parse_arg_shapes(pairs) -> dict:
     return out
 
 
+def _parse_arg_ranges(pairs) -> dict:
+    """``name=-1:1`` (or ``name=-1,1``) -> {name: (-1.0, 1.0)}."""
+    out = {}
+    for pair in pairs or ():
+        if "=" not in pair:
+            raise SystemExit(
+                f"--arg-range expects name=LO:HI, got {pair!r}"
+            )
+        name, _, bounds = pair.partition("=")
+        lo, sep, hi = bounds.replace(",", ":").partition(":")
+        if not sep:
+            raise SystemExit(
+                f"--arg-range expects name=LO:HI, got {pair!r}"
+            )
+        try:
+            out[name] = (float(lo), float(hi))
+        except ValueError:
+            raise SystemExit(
+                f"--arg-range expects numeric bounds, got {pair!r}"
+            ) from None
+        if out[name][0] > out[name][1]:
+            raise SystemExit(
+                f"--arg-range lower bound exceeds upper in {pair!r}"
+            )
+    return out
+
+
+def _context(args) -> dict:
+    """Analysis context from the CLI flags (analyze() forwards each key
+    only to the analysis that understands it)."""
+    ctx: dict = {}
+    arg_specs = _parse_arg_shapes(args.arg_shape)
+    if arg_specs:
+        ctx["arg_specs"] = arg_specs
+    arg_ranges = _parse_arg_ranges(args.arg_range)
+    if arg_ranges:
+        ctx["arg_ranges"] = arg_ranges
+    if args.margin_bits is not None:
+        ctx["margin_bits"] = args.margin_bits
+    if args.jumbo_bytes is not None:
+        ctx["jumbo_bytes"] = args.jumbo_bytes
+    if args.live_buffer_bytes is not None:
+        ctx["live_buffer_bytes"] = args.live_buffer_bytes
+    return ctx
+
+
 def _load(path: str, args):
     from moose_tpu.serde import load_computation
 
@@ -77,18 +137,31 @@ def _lint(comp, args) -> list:
     if args.analyses:
         analyses = [a for a in args.analyses.split(",") if a]
     ignore = [r for r in (args.ignore or "").split(",") if r]
-    return analyze(comp, analyses=analyses, ignore=ignore)
+    return analyze(comp, analyses=analyses, ignore=ignore,
+                   context=_context(args) or None)
 
 
 def _plan_report(comp, args) -> dict:
-    """The ``--schedule``/``--cost`` report for one computation."""
+    """The ``--schedule``/``--cost``/``--ranges`` report for one
+    computation."""
     from moose_tpu.compilation.analysis import (
         cost_report,
+        range_report,
         reconstruct_schedules,
     )
     from moose_tpu.compilation.analysis.schedule import _analyzable
 
     report: dict = {}
+    if args.ranges:
+        # the range report works on any graph (logical or lowered) —
+        # it does not need a schedulable host-level computation
+        report["ranges"] = range_report(
+            comp,
+            arg_specs=_parse_arg_shapes(args.arg_shape) or None,
+            arg_ranges=_parse_arg_ranges(args.arg_range) or None,
+        )
+    if not (args.schedule or args.cost):
+        return report
     if not _analyzable(comp):
         report["analyzable"] = False
         return report
@@ -136,8 +209,8 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--analyses", default=None,
-        help="comma-separated analyses to run (default: all; "
-             "secrecy,communication,signatures,hygiene,schedule,cost)",
+        help="comma-separated analyses to run (default: all; secrecy,"
+             "communication,signatures,hygiene,schedule,cost,ranges)",
     )
     parser.add_argument(
         "--ignore", default=None,
@@ -185,8 +258,35 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--arg-shape", action="append", default=None,
         metavar="NAME=16x8",
-        help="pin an Input/Load op's shape for the cost model "
-             "(repeatable)",
+        help="pin an Input/Load op's shape for the cost and range "
+             "models (repeatable)",
+    )
+    parser.add_argument(
+        "--ranges", action="store_true",
+        help="emit the MSA7xx per-value precision report (fixed-point "
+             "intervals, raw-bit demand, minimal ring width)",
+    )
+    parser.add_argument(
+        "--arg-range", action="append", default=None,
+        metavar="NAME=LO:HI",
+        help="declare a real-space bound for an Input (by name) or "
+             "Load/LoadShares (by storage key); declared bounds arm "
+             "the MSA701/702 overflow checks (repeatable)",
+    )
+    parser.add_argument(
+        "--margin-bits", type=float, default=None,
+        help="MSA702 thin-headroom threshold in bits (default 2; env "
+             "MOOSE_TPU_LINT_MARGIN_BITS)",
+    )
+    parser.add_argument(
+        "--jumbo-bytes", type=int, default=None,
+        help="MSA602 jumbo-transfer note threshold in bytes (default "
+             "64 MiB; env MOOSE_TPU_LINT_JUMBO_BYTES)",
+    )
+    parser.add_argument(
+        "--live-buffer-bytes", type=int, default=None,
+        help="MSA603 live-buffer note threshold in bytes (default "
+             "1 GiB; env MOOSE_TPU_LINT_LIVE_BUFFER_BYTES)",
     )
     parser.add_argument(
         "--explain", action="store_true",
@@ -206,7 +306,7 @@ def main(argv=None) -> int:
     threshold = (
         Severity.WARNING if args.strict_warnings else Severity.ERROR
     )
-    want_report = args.schedule or args.cost
+    want_report = args.schedule or args.cost or args.ranges
     failed = False
     records = []
     reports = {}

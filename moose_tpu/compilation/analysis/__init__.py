@@ -21,13 +21,14 @@ Entry points:
 
 Rule id space: ``MSA1xx`` secrecy, ``MSA2xx`` communication, ``MSA3xx``
 signatures, ``MSA4xx`` hygiene, ``MSA5xx`` execution-plan schedule,
-``MSA6xx`` communication/memory cost.  The full catalogue is in
-:data:`RULES` and documented in DEVELOP.md.
+``MSA6xx`` communication/memory cost, ``MSA7xx`` fixed-point value
+ranges.  The full catalogue is in :data:`RULES` and documented in
+DEVELOP.md.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Any, Dict, Iterable, Optional
 
 from ...computation import Computation
 from ...errors import MalformedComputationError
@@ -43,6 +44,8 @@ from .diagnostics import (
 )
 from .hygiene import RULES as _HYGIENE_RULES
 from .hygiene import analyze_hygiene
+from .ranges import RULES as _RANGE_RULES
+from .ranges import RangeFact, analyze_ranges, infer_ranges, range_report
 from .schedule import RULES as _SCHEDULE_RULES
 from .schedule import (
     analyze_schedule,
@@ -56,10 +59,11 @@ from .signatures import RULES as _SIG_RULES
 from .signatures import analyze_signatures
 
 __all__ = [
-    "ANALYSES", "Diagnostic", "RULES", "Severity", "analyze",
-    "analyze_cost", "analyze_schedule", "build_role_schedule",
-    "cost_report", "format_diagnostics", "infer_specs", "lint_check",
-    "max_severity", "plan_errors", "reconstruct_schedules",
+    "ANALYSES", "Diagnostic", "RULES", "RangeFact", "Severity",
+    "analyze", "analyze_cost", "analyze_ranges", "analyze_schedule",
+    "build_role_schedule", "cost_report", "format_diagnostics",
+    "infer_ranges", "infer_specs", "lint_check", "max_severity",
+    "plan_errors", "range_report", "reconstruct_schedules",
 ]
 
 # name -> analysis function; the public registry (prancer's --analyses
@@ -71,12 +75,21 @@ ANALYSES = {
     "hygiene": analyze_hygiene,
     "schedule": analyze_schedule,
     "cost": analyze_cost,
+    "ranges": analyze_ranges,
+}
+
+# which context keys each analysis accepts; :func:`analyze` forwards
+# only what an analysis understands so callers can pass one context
+# dict without caring which rule family consumes which knob.
+ANALYSIS_CONTEXT_KEYS = {
+    "ranges": ("arg_specs", "arg_ranges", "margin_bits"),
+    "cost": ("jumbo_bytes", "live_buffer_bytes"),
 }
 
 # rule id -> one-line description (prancer --explain, DEVELOP.md).
 RULES = {
     **_SECRECY_RULES, **_COMM_RULES, **_SIG_RULES, **_HYGIENE_RULES,
-    **_SCHEDULE_RULES, **_COST_RULES,
+    **_SCHEDULE_RULES, **_COST_RULES, **_RANGE_RULES,
 }
 
 
@@ -84,15 +97,29 @@ def analyze(
     comp: Computation,
     analyses: Optional[Iterable[str]] = None,
     ignore: Iterable[str] = (),
+    context: Optional[Dict[str, Any]] = None,
 ) -> list[Diagnostic]:
     """Run the selected analyses (default: all) over ``comp`` and return
     every finding, most severe first.  ``ignore`` suppresses rule ids
     (exact, e.g. ``MSA402``) or whole families (prefix, e.g. ``MSA4``).
+    ``context`` carries analysis inputs (``arg_specs``/``arg_ranges``/
+    ``margin_bits`` for ranges, ``jumbo_bytes``/``live_buffer_bytes``
+    for cost); each analysis receives only the keys it understands
+    (:data:`ANALYSIS_CONTEXT_KEYS`).
     """
     names = list(ANALYSES) if analyses is None else list(analyses)
     # a bare string would otherwise iterate per-character and suppress
     # everything ('M' prefix-matches every rule id)
     ignored = (ignore,) if isinstance(ignore, str) else tuple(ignore)
+    ctx = context or {}
+    unknown = set(ctx) - {
+        k for keys in ANALYSIS_CONTEXT_KEYS.values() for k in keys
+    }
+    if unknown:
+        raise ValueError(
+            f"unknown analysis context key(s) {sorted(unknown)}; "
+            f"accepted: {sorted({k for keys in ANALYSIS_CONTEXT_KEYS.values() for k in keys})}"
+        )
     diagnostics: list[Diagnostic] = []
     for name in names:
         try:
@@ -101,7 +128,9 @@ def analyze(
             raise ValueError(
                 f"unknown analysis {name!r}; available: {sorted(ANALYSES)}"
             ) from None
-        diagnostics.extend(fn(comp))
+        accepted = ANALYSIS_CONTEXT_KEYS.get(name, ())
+        kwargs = {k: ctx[k] for k in accepted if k in ctx}
+        diagnostics.extend(fn(comp, **kwargs))
     if ignored:
         diagnostics = [
             d for d in diagnostics
@@ -115,11 +144,13 @@ def lint_check(
     comp: Computation,
     analyses: Optional[Iterable[str]] = None,
     ignore: Iterable[str] = (),
+    context: Optional[Dict[str, Any]] = None,
 ) -> Computation:
     """Analyze ``comp`` and raise :class:`MalformedComputationError`
     carrying the findings if any error-severity diagnostic fired;
     usable directly as a compiler pass."""
-    diagnostics = analyze(comp, analyses=analyses, ignore=ignore)
+    diagnostics = analyze(comp, analyses=analyses, ignore=ignore,
+                          context=context)
     errors = [d for d in diagnostics if d.severity >= Severity.ERROR]
     if errors:
         raise MalformedComputationError(

@@ -48,6 +48,7 @@ pre-networking or composite-placement graphs.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -77,6 +78,23 @@ __all__ = [
 JUMBO_PAYLOAD_BYTES = 64 * 1024 * 1024
 # a segment holding more than this live at once is noted (MSA603)
 LIVE_BUFFER_NOTE_BYTES = 1024 * 1024 * 1024
+
+
+def _threshold(
+    override: Optional[int], env_var: str, default: int
+) -> int:
+    """MSA602/MSA603 note thresholds: explicit argument (prancer
+    --jumbo-bytes / --live-buffer-bytes) beats the env knob beats the
+    module default."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get(env_var)
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return default
 
 UNKNOWN_SHAPE: Optional[Tuple[int, ...]] = None
 
@@ -270,7 +288,10 @@ def _reduce_shape(
     if axis is None:
         return ()
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
-    axes = tuple(a % len(shape) for a in axes)
+    try:
+        axes = tuple(a % len(shape) for a in axes)
+    except (TypeError, ZeroDivisionError):
+        return None
     return tuple(d for i, d in enumerate(shape) if i not in axes)
 
 
@@ -638,6 +659,7 @@ def cost_report(
     transport: str = "grpc",
     coalesce: bool = True,
     schedules: Optional[Dict[str, RoleSchedule]] = None,
+    arg_ranges: Optional[Dict[str, Tuple[float, float]]] = None,
 ) -> Dict[str, Any]:
     """The machine-readable plan report: predicted per-party wire
     counters for ONE session under ``transport`` semantics, plus
@@ -647,7 +669,12 @@ def cost_report(
     transfer key; the client mints 32-hex-char ids).  ``coalesce=False``
     prices the legacy eager scheduler (every send a singleton).
     Predictions match the runtime metrics registry exactly — the
-    ``dist_smoke`` CI gate asserts it."""
+    ``dist_smoke`` CI gate asserts it.
+
+    When ``arg_ranges`` declares real-space input bounds, the report
+    gains a ``ranges`` block (the MSA704 per-value precision report) —
+    together wire bytes + ring-width demand are the planner's inputs
+    for the ring64-vs-ring128 choice (ROADMAP item 4)."""
     from ...distributed.networking import (
         pack_batch_frame,
         pack_value_frame,
@@ -761,7 +788,7 @@ def cost_report(
             "send_many_payloads", "receives",
         )
     }
-    return {
+    report = {
         "transport": transport,
         "coalesce": coalesce,
         "session_id_len": len(session_id),
@@ -769,12 +796,33 @@ def cost_report(
         "per_party": per_party,
         "totals": totals,
     }
+    if arg_ranges is not None:
+        from .ranges import range_report
+
+        report["ranges"] = range_report(
+            comp, arg_specs=arg_specs, arg_ranges=arg_ranges
+        )
+    return report
 
 
-def analyze_cost(comp: Computation) -> List[Diagnostic]:
-    """MSA6xx entry point registered with :func:`analysis.analyze`."""
+def analyze_cost(
+    comp: Computation,
+    jumbo_bytes: Optional[int] = None,
+    live_buffer_bytes: Optional[int] = None,
+) -> List[Diagnostic]:
+    """MSA6xx entry point registered with :func:`analysis.analyze`.
+    ``jumbo_bytes``/``live_buffer_bytes`` override the MSA602/MSA603
+    note thresholds (env: ``MOOSE_TPU_LINT_JUMBO_BYTES``,
+    ``MOOSE_TPU_LINT_LIVE_BUFFER_BYTES``)."""
     if not _analyzable(comp):
         return []
+    jumbo = _threshold(
+        jumbo_bytes, "MOOSE_TPU_LINT_JUMBO_BYTES", JUMBO_PAYLOAD_BYTES
+    )
+    live_note = _threshold(
+        live_buffer_bytes, "MOOSE_TPU_LINT_LIVE_BUFFER_BYTES",
+        LIVE_BUFFER_NOTE_BYTES,
+    )
     try:
         schedules = reconstruct_schedules(comp)
     except ValueError:
@@ -796,11 +844,11 @@ def analyze_cost(comp: Computation) -> List[Diagnostic]:
                 f"this graph",
                 op=name, placement=op.placement_name,
             ))
-        elif size > JUMBO_PAYLOAD_BYTES:
+        elif size > jumbo:
             diagnostics.append(Diagnostic(
                 "MSA602", Severity.INFO,
                 f"jumbo transfer: payload {op.inputs[0]!r} serializes "
-                f"to {size} bytes (> {JUMBO_PAYLOAD_BYTES})",
+                f"to {size} bytes (> {jumbo})",
                 op=name, placement=op.placement_name,
             ))
     for role in sorted(schedules):
@@ -809,12 +857,12 @@ def analyze_cost(comp: Computation) -> List[Diagnostic]:
             hwm, exact = _segment_live_hwm(
                 comp, seg.names, seg.in_names, seg.out_names, specs
             )
-            if exact and hwm is not None and hwm > LIVE_BUFFER_NOTE_BYTES:
+            if exact and hwm is not None and hwm > live_note:
                 diagnostics.append(Diagnostic(
                     "MSA603", Severity.INFO,
                     f"segment {seg.index} on {role!r} holds "
                     f"{hwm} bytes live at its high-water mark "
-                    f"(> {LIVE_BUFFER_NOTE_BYTES})",
+                    f"(> {live_note})",
                     op=seg.names[0], placement=role,
                 ))
     return diagnostics

@@ -22,6 +22,14 @@ Rules:
 - ``MSA104`` (warning): a secret value is consumed on a Mirrored3
   placement; mirrored values are public to all owners, so this
   broadcast-reveals the secret.
+- ``MSA105`` (error): a plaintext ``Save`` persists a secret-derived
+  value — unlike the transient reveal idiom, this writes the secret to
+  durable party-local storage.  The per-party ring-share limb-plane
+  Saves that ``save_shares`` lowers to (ring-typed value, party-local
+  ``<key>#s0``/``<key>#s1`` keys — see ``lowering.share_key``) are
+  share-typed and pass: each party persists only the two additive
+  shares it already holds, which reveal nothing without the other two
+  storages.
 """
 
 from __future__ import annotations
@@ -39,6 +47,30 @@ DECLASSIFYING_KINDS = frozenset({
     "Reveal", "Cast", "Output", "Save",
     "FixedpointDecode", "RingFixedpointDecode",
 })
+
+# storage-key suffixes of the per-party share planes save_shares lowers
+# to (lowering.share_key): every party holds the same two keys, each
+# plane is one additive share — useless without the other storages.
+_SHARE_KEY_SUFFIXES = ("#s0", "#s1")
+
+
+def _is_share_plane_save(comp: Computation, op) -> bool:
+    """True for the ring-share limb-plane Saves emitted by the training
+    storage lowering: the persisted value is ring-typed (a raw share,
+    not a decoded plaintext) AND the key is a party-local share plane
+    (``<key>#s0``/``#s1``)."""
+    if len(op.inputs) < 2:
+        return False
+    value_ty = None
+    if len(op.signature.input_types) >= 2:
+        value_ty = op.signature.input_types[1]
+    if value_ty is None or "Ring" not in value_ty.name:
+        return False
+    key_op = comp.operations.get(op.inputs[0])
+    if key_op is None or key_op.kind != "Constant":
+        return False
+    key = key_op.attributes.get("value")
+    return isinstance(key, str) and key.endswith(_SHARE_KEY_SUFFIXES)
 
 
 def analyze_secrecy(comp: Computation) -> list[Diagnostic]:
@@ -92,7 +124,24 @@ def analyze_secrecy(comp: Computation) -> list[Diagnostic]:
                 op=name, placement=op.placement_name,
             ))
             continue
-        if op.kind in DECLASSIFYING_KINDS:
+        if op.kind == "Save":
+            # persisting beats revealing: a transient host reveal is the
+            # deliberate exit idiom (MSA103), but writing a
+            # secret-derived value to durable party storage is a leak —
+            # unless it is a share-typed limb-plane Save, which persists
+            # only what the party already holds
+            if _is_share_plane_save(comp, op):
+                continue
+            diagnostics.append(Diagnostic(
+                "MSA105", Severity.ERROR,
+                f"secret persisted in the clear: Save writes "
+                f"secret-derived value(s) {secret_inputs} to this "
+                f"party's durable storage; reveal explicitly first "
+                f"(Cast/Reveal) or use save_shares to keep the "
+                f"checkpoint secret-shared",
+                op=name, placement=op.placement_name,
+            ))
+        elif op.kind in DECLASSIFYING_KINDS:
             diagnostics.append(Diagnostic(
                 "MSA103", Severity.INFO,
                 f"declassification point: {op.kind} reveals "
@@ -125,4 +174,7 @@ RULES = {
     "MSA103": "declassification point (informational audit trail)",
     "MSA104": "secret consumed on a Mirrored3 placement (public to all "
               "owners)",
+    "MSA105": "secret persisted in the clear: plaintext Save of a "
+              "secret-derived value (share-typed #s0/#s1 limb-plane "
+              "saves pass)",
 }

@@ -535,6 +535,9 @@ class RolePlan:
     segments) plus per-segment validated-jit state.  Cached weak-keyed
     on the computation, so it must not hold it strongly."""
 
+    # MSA704 summary attached by get_plan (advisory; {} until set)
+    ranges_advisory: dict = {}
+
     def __init__(self, comp, identity: str):
         from ..execution.interpreter import _selfcheck_runs
 
@@ -598,6 +601,32 @@ _cache_lock = threading.Lock()
 # plans themselves.
 _verdict_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
+# MSA704 summary per computation (advisory only — the worker has no
+# declared arg ranges, so this is the structural representable-interval
+# demand; it never rejects a plan).
+_ranges_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _ranges_advisory(comp) -> dict:
+    """The range analysis' per-computation summary (peak raw-bit demand,
+    minimal ring width), attached to every resolved plan so operators
+    can see ring-width headroom per role without rerunning prancer.
+    Advisory by construction: no declared ranges, no errors raised."""
+    with _cache_lock:
+        cached = _ranges_cache.get(comp)
+    if cached is not None:
+        return cached
+    try:
+        from ..compilation.analysis.ranges import range_report
+
+        advisory = dict(range_report(comp)["summary"])
+    except Exception:  # noqa: BLE001 — advisory data must never take
+        # down plan building
+        advisory = {}
+    with _cache_lock:
+        _ranges_cache[comp] = advisory
+    return advisory
+
 
 def _schedule_errors(comp) -> list:
     with _cache_lock:
@@ -647,6 +676,7 @@ def get_plan(comp, identity: str,
             diagnostics=errors,
         )
     plan = RolePlan(comp, identity)
+    plan.ranges_advisory = _ranges_advisory(comp)
     with _cache_lock:
         existing = _plan_cache[comp].get(identity)
         if existing is not None:
@@ -661,6 +691,8 @@ def get_plan(comp, identity: str,
         "plan_built", party=identity, session=session_id,
         mode=plan.plan_mode, segments=len(plan.segments),
         steps=len(plan.steps), receives=len(plan.recv_names),
+        min_ring_width=plan.ranges_advisory.get("min_ring_width"),
+        peak_raw_bits=plan.ranges_advisory.get("peak_raw_bits"),
     )
     return plan
 

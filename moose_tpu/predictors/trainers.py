@@ -40,7 +40,8 @@ class SecureTrainer(predictor.Predictor):
     object, so epochs MUST reuse it), checkpoint key layout."""
 
     def __init__(self, checkpoint_key: str, learning_rate: float,
-                 fixedpoint_dtype, steps_per_epoch: int):
+                 fixedpoint_dtype, steps_per_epoch: int,
+                 feature_range=(-1.0, 1.0), weight_range=(-1.0, 1.0)):
         super().__init__()
         if steps_per_epoch < 1:
             raise ValueError("steps_per_epoch must be >= 1")
@@ -52,6 +53,18 @@ class SecureTrainer(predictor.Predictor):
             else predictor_utils.DEFAULT_FIXED_DTYPE
         )
         self.steps_per_epoch = int(steps_per_epoch)
+        # declared real-space bounds the data/model owners assert for
+        # features and weights (labels are structurally in [0, 1]) —
+        # these seed the MSA7xx range analysis, which every traced
+        # trainer graph is linted against at build time: an encoding
+        # that cannot hold the declared training dynamics is a
+        # compile-time MSA701 error, not a silent ring wraparound
+        self.feature_range = (
+            float(feature_range[0]), float(feature_range[1])
+        )
+        self.weight_range = (
+            float(weight_range[0]), float(weight_range[1])
+        )
 
     # -- checkpoint layout ----------------------------------------------
 
@@ -100,6 +113,43 @@ class SecureTrainer(predictor.Predictor):
             pm.save_shares(self.state_key(name), state[name])
             for name in sorted(self.state_shapes)
         ]
+
+    def range_specs(self, n_rows: int = None) -> tuple:
+        """``(arg_specs, arg_ranges)`` declaring what the trainer
+        actually knows about its graphs: input shapes (``x``/``y`` when
+        ``n_rows`` is known, the state tensors always) and real-space
+        bounds (features/weights from the declared ranges, labels
+        structurally in [0, 1]) — keyed by Input arg name for init/step
+        graphs and by checkpoint storage key for the LoadShares ops of
+        epoch/export graphs."""
+        arg_specs = {
+            name: shape for name, shape in self.state_shapes.items()
+        }
+        if n_rows is not None:
+            arg_specs["x"] = (int(n_rows), self.n_features)
+            arg_specs["y"] = (int(n_rows), 1)
+        arg_ranges = {
+            "x": self.feature_range,
+            "y": (0.0, 1.0),
+        }
+        for name in self.state_shapes:
+            arg_ranges[name] = self.weight_range
+            arg_ranges[self.state_key(name)] = self.weight_range
+        return arg_specs, arg_ranges
+
+    def _range_lint(self, comp, n_rows: int = None):
+        """Build-time MSA7xx gate: every trainer graph is linted against
+        the trainer's declared ranges the moment it is traced, so a
+        fixed-point config that cannot hold the declared training
+        dynamics fails at build time with the bit-growth chain."""
+        from ..compilation.analysis import lint_check
+
+        arg_specs, arg_ranges = self.range_specs(n_rows)
+        lint_check(
+            comp, analyses=["ranges"],
+            context={"arg_specs": arg_specs, "arg_ranges": arg_ranges},
+        )
+        return comp
 
     def _batches(self, n_rows: int):
         """(start, stop) bounds of each in-graph minibatch step."""
@@ -150,7 +200,7 @@ class SecureTrainer(predictor.Predictor):
             body.__signature__ = inspect.Signature(params)
             from ..edsl import tracer
 
-            return tracer.trace(pm.computation(body))
+            return self._range_lint(tracer.trace(pm.computation(body)))
 
         return self._memoized(("init", self.fixedpoint_dtype), build)
 
@@ -197,7 +247,9 @@ class SecureTrainer(predictor.Predictor):
             ])
             from ..edsl import tracer
 
-            return tracer.trace(pm.computation(body))
+            return self._range_lint(
+                tracer.trace(pm.computation(body)), n_rows=n_rows
+            )
 
         return self._memoized(
             ("epoch", self.fixedpoint_dtype, n_rows), build
@@ -256,7 +308,9 @@ class SecureTrainer(predictor.Predictor):
             body.__signature__ = inspect.Signature(params)
             from ..edsl import tracer
 
-            return tracer.trace(pm.computation(body))
+            return self._range_lint(
+                tracer.trace(pm.computation(body)), n_rows=n_rows
+            )
 
         return self._memoized(
             ("step", self.fixedpoint_dtype, n_rows), build
@@ -284,7 +338,7 @@ class SecureTrainer(predictor.Predictor):
             body.__signature__ = inspect.Signature([])
             from ..edsl import tracer
 
-            return tracer.trace(pm.computation(body))
+            return self._range_lint(tracer.trace(pm.computation(body)))
 
         return self._memoized(("export", self.fixedpoint_dtype), build)
 
@@ -318,10 +372,12 @@ class LogregSGDTrainer(SecureTrainer):
 
     def __init__(self, n_features: int, learning_rate: float = 0.1,
                  checkpoint_key: str = "ckpt/logreg",
-                 fixedpoint_dtype=None, steps_per_epoch: int = 1):
+                 fixedpoint_dtype=None, steps_per_epoch: int = 1,
+                 feature_range=(-1.0, 1.0), weight_range=(-1.0, 1.0)):
         super().__init__(
             checkpoint_key, learning_rate, fixedpoint_dtype,
-            steps_per_epoch,
+            steps_per_epoch, feature_range=feature_range,
+            weight_range=weight_range,
         )
         self.n_features = int(n_features)
 
@@ -356,10 +412,12 @@ class MLPSGDTrainer(SecureTrainer):
     def __init__(self, n_features: int, hidden: int,
                  learning_rate: float = 0.1,
                  checkpoint_key: str = "ckpt/mlp",
-                 fixedpoint_dtype=None, steps_per_epoch: int = 1):
+                 fixedpoint_dtype=None, steps_per_epoch: int = 1,
+                 feature_range=(-1.0, 1.0), weight_range=(-1.0, 1.0)):
         super().__init__(
             checkpoint_key, learning_rate, fixedpoint_dtype,
-            steps_per_epoch,
+            steps_per_epoch, feature_range=feature_range,
+            weight_range=weight_range,
         )
         self.n_features = int(n_features)
         self.hidden = int(hidden)

@@ -127,6 +127,7 @@ class ModelRegistry:
         fixedpoint_dtype=None,
         input_name: Optional[str] = None,
         max_warmup_evals: int = 12,
+        arg_ranges: Optional[Dict[str, Tuple[float, float]]] = None,
     ) -> RegisteredModel:
         """Trace, compile, and ladder-validate ``model`` for every batch
         bucket; returns the warm :class:`RegisteredModel`.
@@ -136,7 +137,14 @@ class ModelRegistry:
         already-traced ``Computation``.  ``row_shape`` is the per-row
         input shape (e.g. ``(n_features,)``).  Each bucket is warmed
         until the runtime reports a non-``validating`` plan state, so
-        serving traffic never executes a ladder step."""
+        serving traffic never executes a ladder step.
+
+        ``arg_ranges`` optionally declares real-space input bounds
+        ({input name: (lo, hi)}); when given, the MSA7xx range analysis
+        runs strictly at the door against the LARGEST batch bucket
+        (worst-case dot accumulation), so a model whose fixed-point
+        encoding cannot hold the declared input dynamics is rejected at
+        registration instead of wrapping in the ring at serve time."""
         if name in self._models:
             raise ConfigurationError(f"model {name!r} already registered")
         with telemetry.span("register_model", model=name) as root:
@@ -160,6 +168,21 @@ class ModelRegistry:
                 raise ConfigurationError(
                     f"buckets must all be >= 1, got {buckets}"
                 )
+            if arg_ranges:
+                # before any warmup spend: overflow against the largest
+                # bucket is a registration-time rejection
+                with telemetry.span("lint_ranges", model=name):
+                    lint_check(
+                        comp, analyses=["ranges"],
+                        context={
+                            "arg_specs": {
+                                input_name: (
+                                    buckets[-1], *tuple(row_shape)
+                                )
+                            },
+                            "arg_ranges": dict(arg_ranges),
+                        },
+                    )
             warmup_report: Dict[int, dict] = {}
             for bucket in buckets:
                 warmup_report[bucket] = self._warm_bucket(

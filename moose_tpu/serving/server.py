@@ -54,9 +54,13 @@ class InferenceServer:
         buckets: Tuple[int, ...] = (),
         fixedpoint_dtype=None,
         input_name: Optional[str] = None,
+        arg_ranges=None,
     ):
         """Register + warm a model and start its micro-batch scheduler.
-        Buckets default to powers of two up to ``config.max_batch``."""
+        Buckets default to powers of two up to ``config.max_batch``.
+        ``arg_ranges`` declares real-space input bounds and arms the
+        MSA7xx overflow gate at registration (see
+        ``ModelRegistry.register``)."""
         if self._closed:
             raise ConfigurationError("server is shut down")
         registered = self.registry.register(
@@ -66,6 +70,7 @@ class InferenceServer:
             buckets=buckets,
             fixedpoint_dtype=fixedpoint_dtype,
             input_name=input_name,
+            arg_ranges=arg_ranges,
         )
         self._queues[name] = ModelQueue(
             model=registered,

@@ -43,7 +43,7 @@ import json
 import os
 import threading
 import time
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -57,10 +57,10 @@ CKPT_FORMAT = 1
 # graph-level key prefix so a graph key can never collide with it)
 _META = "_ckpt"
 
-_METRICS = None
+_METRICS: Optional[Dict[str, Any]] = None
 
 
-def _metrics():
+def _metrics() -> Dict[str, Any]:
     global _METRICS
     if _METRICS is None:
         _METRICS = {
@@ -106,44 +106,44 @@ def _array_digest(arr: np.ndarray) -> str:
 # -- backend shims (FilesystemStorage protocol OR plain dict) -----------
 
 
-def _b_save(backing, key: str, value) -> None:
+def _b_save(backing: Any, key: str, value: Any) -> None:
     if hasattr(backing, "save"):
         backing.save(key, value)
     else:
         backing[key] = np.asarray(value)
 
 
-def _b_load(backing, key: str):
+def _b_load(backing: Any, key: str) -> Any:
     if hasattr(backing, "load"):
         return backing.load(key)
     return backing[key]
 
 
-def _b_contains(backing, key: str) -> bool:
+def _b_contains(backing: Any, key: str) -> bool:
     return key in backing
 
 
-def _b_list(backing, prefix: str) -> list:
+def _b_list(backing: Any, prefix: str) -> List[str]:
     if hasattr(backing, "list_keys"):
         return backing.list_keys(prefix)
     return sorted(k for k in backing if k.startswith(prefix))
 
 
-def _b_delete(backing, key: str) -> None:
+def _b_delete(backing: Any, key: str) -> None:
     if hasattr(backing, "delete"):
         backing.delete(key)
     else:
         backing.pop(key, None)
 
 
-def _json_save(backing, key: str, obj) -> None:
+def _json_save(backing: Any, key: str, obj: Any) -> None:
     _b_save(
         backing, key,
         np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8).copy(),
     )
 
 
-def _json_load(backing, key: str):
+def _json_load(backing: Any, key: str) -> Any:
     return json.loads(bytes(np.asarray(_b_load(backing, key))).decode())
 
 
@@ -153,8 +153,8 @@ class CheckpointStore:
     interface (``load``/``__getitem__``/``__setitem__``/
     ``__contains__``/``setdefault``)."""
 
-    def __init__(self, backing, party: str = "", prefix: str = "ckpt/",
-                 retain: int = 2):
+    def __init__(self, backing: Any, party: str = "",
+                 prefix: str = "ckpt/", retain: int = 2) -> None:
         if retain < 2:
             # the two-phase commit protocol NEEDS the previous
             # generation to survive one more epoch: a party that
@@ -168,9 +168,9 @@ class CheckpointStore:
         self.prefix = prefix
         self.retain = int(retain)
         self._lock = threading.RLock()
-        self._staged: dict = {}
+        self._staged: Dict[str, np.ndarray] = {}
         # generation -> manifest (validated) / None (known invalid)
-        self._verdicts: dict = {}
+        self._verdicts: Dict[int, Optional[dict]] = {}
         # memoized read-generation: every checkpoint load/contains
         # would otherwise re-walk the backend's key space (a recursive
         # directory scan on FilesystemStorage) — the only mutation
@@ -180,17 +180,17 @@ class CheckpointStore:
 
     # -- storage protocol (what workers and local runtimes call) --------
 
-    def load(self, key: str, query: str = ""):
+    def load(self, key: str, query: str = "") -> Any:
         if not key.startswith(self.prefix):
             return _b_load(self.backing, key)
         with self._lock:
             gen = self._read_generation()
             return _b_load(self.backing, f"{_META}/gen-{gen:08d}/{key}")
 
-    def __getitem__(self, key: str):
+    def __getitem__(self, key: str) -> Any:
         return self.load(key)
 
-    def __setitem__(self, key: str, value) -> None:
+    def __setitem__(self, key: str, value: Any) -> None:
         if not key.startswith(self.prefix):
             _b_save(self.backing, key, value)
             return
@@ -211,13 +211,13 @@ class CheckpointStore:
             self.backing, f"{_META}/gen-{gen:08d}/{key}"
         )
 
-    def setdefault(self, key: str, default):
+    def setdefault(self, key: str, default: Any) -> Any:
         return self.load(key) if key in self else default
 
     # -- generation resolution ------------------------------------------
 
-    def _generations(self) -> list:
-        gens = set()
+    def _generations(self) -> List[int]:
+        gens: set = set()
         head = f"{_META}/gen-"
         for key in _b_list(self.backing, head):
             rest = key[len(head):]
@@ -226,13 +226,13 @@ class CheckpointStore:
                 gens.add(int(num))
         return sorted(gens)
 
-    def _manifest(self, gen: int) -> Optional[dict]:
+    def _manifest(self, gen: int) -> Optional[Dict[str, Any]]:
         """Validated manifest of ``gen``, or None when the generation is
         torn/tampered/stale (verdicts memoized per store instance)."""
         if gen in self._verdicts:
             return self._verdicts[gen]
-        verdict = None
-        reason = None
+        verdict: Optional[Dict[str, Any]] = None
+        reason: Optional[str] = None
         try:
             manifest = _json_load(
                 self.backing, f"{_META}/gen-{gen:08d}/MANIFEST"
@@ -290,7 +290,7 @@ class CheckpointStore:
                 f"{self.party}: no valid checkpoint generation for "
                 f"pinned epoch {pin}"
             )
-        current = None
+        current: Optional[dict] = None
         if _b_contains(self.backing, f"{_META}/CURRENT"):
             try:
                 current = _json_load(self.backing, f"{_META}/CURRENT")
@@ -329,7 +329,7 @@ class CheckpointStore:
         entry per epoch — the newest valid generation wins), the
         current epoch, the durable pin, and what is currently staged."""
         with self._lock:
-            by_epoch: dict = {}
+            by_epoch: Dict[int, int] = {}
             for gen in self._generations():
                 manifest = self._manifest(gen)
                 if manifest is not None:
@@ -400,7 +400,7 @@ class CheckpointStore:
             gens = self._generations()
             gen = (gens[-1] + 1) if gens else 0
             head = f"{_META}/gen-{gen:08d}"
-            keys: dict = {}
+            keys: Dict[str, Dict[str, Any]] = {}
             for key, arr in sorted(self._staged.items()):
                 _b_save(self.backing, f"{head}/{key}", arr)
                 keys[key] = {
@@ -462,7 +462,7 @@ class CheckpointStore:
 
     # -- rpc dispatch ----------------------------------------------------
 
-    def checkpoint_control(self, cmd: str, args: dict):
+    def checkpoint_control(self, cmd: str, args: dict) -> dict:
         """Single dispatch point for the choreography StorageControl
         rpc (and the in-process driver): every command returns a
         msgpack-able dict."""

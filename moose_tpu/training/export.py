@@ -19,6 +19,7 @@ live version in the PR-4 serving registry —
 from __future__ import annotations
 
 from types import SimpleNamespace
+from typing import Any, Optional
 
 import numpy as np
 
@@ -26,7 +27,7 @@ from ..predictors import sklearn_export
 
 
 def logreg_onnx_bytes(weights: np.ndarray,
-                      intercept: np.ndarray = None) -> bytes:
+                      intercept: Optional[np.ndarray] = None) -> bytes:
     """Serialize trained logistic-regression weights as a
     skl2onnx-layout LinearClassifier ONNX model (binary: both class
     rows, LOGISTIC post-transform) — importable by ``from_onnx`` and
@@ -46,7 +47,8 @@ def logreg_onnx_bytes(weights: np.ndarray,
     ).encode()
 
 
-def trained_predictor(weights: np.ndarray, intercept: np.ndarray = None):
+def trained_predictor(weights: np.ndarray,
+                      intercept: Optional[np.ndarray] = None) -> Any:
     """A ``predictors`` instance for the trained logreg weights (the
     object form of :func:`logreg_onnx_bytes`)."""
     from ..predictors import from_onnx
@@ -54,8 +56,8 @@ def trained_predictor(weights: np.ndarray, intercept: np.ndarray = None):
     return from_onnx(logreg_onnx_bytes(weights, intercept))
 
 
-def hot_swap(server, name: str, weights: np.ndarray,
-             intercept: np.ndarray = None):
+def hot_swap(server: Any, name: str, weights: np.ndarray,
+             intercept: Optional[np.ndarray] = None) -> Any:
     """Replace the live model ``name`` on an in-process
     ``InferenceServer`` with freshly trained weights, zero requests
     dropped (see ``InferenceServer.replace_model``)."""

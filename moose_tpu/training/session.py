@@ -36,16 +36,16 @@ from __future__ import annotations
 import dataclasses
 import random
 import time
-from typing import Optional
+from typing import Any, Callable, Dict, Optional
 
 from .. import flight as flight_mod
 from .. import metrics as metrics_mod
 from ..errors import CheckpointError, MooseError, is_retryable
 
-_METRICS = None
+_METRICS: Optional[Dict[str, Any]] = None
 
 
-def _metrics():
+def _metrics() -> Dict[str, Any]:
     global _METRICS
     if _METRICS is None:
         _METRICS = {
@@ -95,7 +95,7 @@ class LocalTrainingCluster:
     are :class:`~moose_tpu.training.checkpoint.CheckpointStore`
     objects."""
 
-    def __init__(self, runtime, parties):
+    def __init__(self, runtime: Any, parties: Any) -> None:
         self.runtime = runtime
         self.parties = list(parties)
         for party in self.parties:
@@ -107,12 +107,12 @@ class LocalTrainingCluster:
                     "{party: CheckpointStore(...)})"
                 )
 
-    def run(self, comp, arguments, timeout):
+    def run(self, comp: Any, arguments: Any, timeout: float) -> Any:
         return self.runtime.evaluate_computation(
             comp, arguments=arguments
         )
 
-    def control(self, party: str, cmd: str, **args):
+    def control(self, party: str, cmd: str, **args: Any) -> Any:
         return self.runtime.storage[party].checkpoint_control(cmd, args)
 
 
@@ -122,17 +122,18 @@ class GrpcTrainingCluster:
     in-session retries, abort fanout), checkpoint control through the
     choreography StorageControl rpc."""
 
-    def __init__(self, client, parties: Optional[list] = None):
+    def __init__(self, client: Any,
+                 parties: Optional[list] = None) -> None:
         self.client = client
         self.parties = list(parties or client.identities)
 
-    def run(self, comp, arguments, timeout):
+    def run(self, comp: Any, arguments: Any, timeout: float) -> Any:
         outputs, _ = self.client.run_computation(
             comp, arguments, timeout=timeout
         )
         return outputs
 
-    def control(self, party: str, cmd: str, **args):
+    def control(self, party: str, cmd: str, **args: Any) -> Any:
         from ..distributed.client import _classify_rpc_error
 
         try:
@@ -151,8 +152,8 @@ class TrainingSession:
     """Supervised, checkpointed, resumable multi-epoch secure training
     of one ``predictors.trainers.SecureTrainer`` model."""
 
-    def __init__(self, trainer, cluster,
-                 config: Optional[TrainingConfig] = None):
+    def __init__(self, trainer: Any, cluster: Any,
+                 config: Optional[TrainingConfig] = None) -> None:
         self.trainer = trainer
         self.cluster = cluster
         self.config = config or TrainingConfig()
@@ -163,7 +164,7 @@ class TrainingSession:
 
     # -- party control fanout -------------------------------------------
 
-    def _control_all(self, cmd: str, **args) -> dict:
+    def _control_all(self, cmd: str, **args: Any) -> dict:
         return {
             party: self.cluster.control(party, cmd, **args)
             for party in self.cluster.parties
@@ -173,14 +174,14 @@ class TrainingSession:
         """The newest epoch committed (and still valid) on EVERY party
         — the only state the protocol may resume from."""
         queries = self._control_all("query")
-        common = None
+        common: Optional[int] = None
         sets = [set(q["epochs"]) for q in queries.values()]
         inter = set.intersection(*sets) if sets else set()
         if inter:
             common = max(inter)
         return common
 
-    def _with_retries(self, fn, what: str):
+    def _with_retries(self, fn: Callable[[], Any], what: str) -> Any:
         """Retryable-failure envelope for control-plane steps OUTSIDE
         the epoch loop (queries, the final unpin, the export session):
         a worker mid-restart answers UNAVAILABLE for a second or two,
@@ -215,7 +216,7 @@ class TrainingSession:
 
     # -- the supervisor loop --------------------------------------------
 
-    def run(self, x, y) -> dict:
+    def run(self, x: Any, y: Any) -> dict:
         """Train to ``config.epochs`` committed epochs, resuming from
         whatever is already durably committed.  Returns the report dict
         (also kept as ``last_report``); trained weights under
@@ -295,7 +296,7 @@ class TrainingSession:
         _metrics()["runs"].inc(outcome="ok")
         return report
 
-    def _initial_value(self, name: str, shape):
+    def _initial_value(self, name: str, shape: Any) -> Any:
         """Deterministic small init (the model owner would supply real
         initial weights; trainers may override via ``initial_weights``
         attribute)."""
@@ -317,7 +318,8 @@ class TrainingSession:
         rng = np.random.default_rng(int.from_bytes(digest, "big"))
         return rng.normal(size=shape) * 0.1
 
-    def _run_epoch(self, report, epoch: int, comp, arguments) -> None:
+    def _run_epoch(self, report: dict, epoch: int, comp: Any,
+                   arguments: Any) -> None:
         """One epoch (or the init bootstrap) with epoch-level recovery:
         pin -> session -> commit, retrying retryable failures from the
         re-queried common committed state."""

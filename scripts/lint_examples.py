@@ -41,6 +41,53 @@ TARGETS = [
 ]
 
 
+def trainer_graphs():
+    """(label, computation, extra prancer flags) for every trainer graph
+    shape at BOTH shipped precisions — logreg/MLP init, epoch, and
+    standalone step — with the trainer's real shapes and declared
+    feature/weight/label ranges passed via --arg-shape/--arg-range, so
+    the MSA7xx overflow checks (and the MSA105 storage taint rules on
+    the checkpoint boundary ops) are armed, not just advisory."""
+    import moose_tpu as pm
+    from moose_tpu.predictors.trainers import (
+        LogregSGDTrainer,
+        MLPSGDTrainer,
+    )
+
+    n_rows = 16
+    out = []
+    for fx, tag in (
+        (pm.fixed(8, 17), "fixed(8,17)/ring64"),
+        (pm.fixed(24, 40), "fixed(24,40)/ring128"),
+    ):
+        trainers = [
+            ("logreg", LogregSGDTrainer(4, fixedpoint_dtype=fx,
+                                        steps_per_epoch=2)),
+            ("mlp", MLPSGDTrainer(4, 3, fixedpoint_dtype=fx,
+                                  steps_per_epoch=2)),
+        ]
+        for mname, trainer in trainers:
+            graphs = [
+                ("init", trainer.init_computation(), None),
+                ("epoch", trainer.epoch_computation(n_rows), n_rows),
+                ("step", trainer.step_computation(n_rows), n_rows),
+            ]
+            for gname, comp, rows in graphs:
+                arg_specs, arg_ranges = trainer.range_specs(rows)
+                flags = [
+                    f"--arg-shape={name}="
+                    + "x".join(str(d) for d in shape)
+                    for name, shape in sorted(arg_specs.items())
+                ] + [
+                    f"--arg-range={name}={lo}:{hi}"
+                    for name, (lo, hi) in sorted(arg_ranges.items())
+                ]
+                out.append(
+                    (f"{mname} trainer {gname} @ {tag}", comp, flags)
+                )
+    return out
+
+
 def build_resnet_computation():
     import moose_tpu as pm
     from moose_tpu import predictors
@@ -63,9 +110,9 @@ def main() -> int:
     graphs = []
     for label, modname, attr in TARGETS:
         comp_fn = getattr(importlib.import_module(modname), attr)
-        graphs.append((label, tracer.trace(comp_fn)))
+        graphs.append((label, tracer.trace(comp_fn), []))
     graphs.append(
-        ("resnet predictor", tracer.trace(build_resnet_computation()))
+        ("resnet predictor", tracer.trace(build_resnet_computation()), [])
     )
 
     # full pipeline on the constants-only tutorial graph: lowering,
@@ -74,15 +121,21 @@ def main() -> int:
     graphs.append((
         "tutorial dot product (lowered + networked)",
         compile_computation(logical, passes=DEFAULT_PASSES),
+        [],
     ))
+
+    # every trainer graph at both shipped precisions, with declared
+    # ranges armed — MSA105/MSA7xx regressions on training graphs fail
+    # here
+    graphs.extend(trainer_graphs())
 
     failures = 0
     linted = 0
     with tempfile.TemporaryDirectory() as tmp:
-        for i, (label, comp) in enumerate(graphs):
+        for i, (label, comp, flags) in enumerate(graphs):
             path = pathlib.Path(tmp) / f"comp_{i}.moose"
             path.write_text(to_textual(comp))
-            rc = prancer([str(path)])
+            rc = prancer([str(path), *flags])
             status = "clean" if rc == 0 else "FAILED"
             print(f"[{status}] {label} ({len(comp.operations)} ops)")
             failures += rc != 0

@@ -1,7 +1,9 @@
-"""mypy --strict gate over ``moose_tpu/compilation/analysis/`` (CI).
+"""mypy --strict gate over the typed core (CI).
 
 The static analyzer judges other code; it must itself be type-clean.
-Scope and the per-flag relaxations for gradually-typed neighbor modules
+The training storage layer (checkpoints, sessions, export) crosses
+trust boundaries and is in scope for the same reason.  Scope and the
+per-flag relaxations for gradually-typed neighbor modules
 (follow_imports=silent, untyped calls permitted) live in
 ``pyproject.toml`` ``[tool.mypy]`` — this wrapper only adds the
 --strict baseline and a friendly skip when mypy is not installed (dev
@@ -16,7 +18,10 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 
-TARGET = "moose_tpu/compilation/analysis"
+TARGETS = [
+    "moose_tpu/compilation/analysis",
+    "moose_tpu/training",
+]
 
 
 def main() -> int:
@@ -35,7 +40,7 @@ def main() -> int:
         # the two that --strict turns back on)
         "--allow-untyped-calls", "--no-warn-return-any",
         "--allow-any-generics",
-        str(ROOT / TARGET),
+        *(str(ROOT / target) for target in TARGETS),
     ]
     print("$", " ".join(cmd))
     return subprocess.call(cmd, cwd=ROOT)

@@ -451,10 +451,10 @@ def test_ndarray_attributes_are_structurally_compared():
 def test_every_rule_is_catalogued():
     assert set(ANALYSES) == {
         "secrecy", "communication", "signatures", "hygiene",
-        "schedule", "cost",
+        "schedule", "cost", "ranges",
     }
     assert {r[:4] for r in RULES} == {
-        "MSA1", "MSA2", "MSA3", "MSA4", "MSA5", "MSA6"
+        "MSA1", "MSA2", "MSA3", "MSA4", "MSA5", "MSA6", "MSA7"
     }
 
 
@@ -1090,3 +1090,302 @@ def test_prancer_cli_schedule_and_cost_report(tmp_path, capsys):
     assert rc == 0
     payload = json.loads(capsys.readouterr().out)
     assert set(payload["reports"][str(path)]["schedule"]) == {"alice"}
+
+
+# ---------------------------------------------------------------------------
+# MSA7xx fixed-point value ranges + MSA105 storage secrecy (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _fixed_predict_graph(fx=None):
+    """Tiny logreg-shaped scoring graph: cast -> dot -> sigmoid ->
+    reveal, at precision ``fx`` (default fixed(8,17)/ring64)."""
+    fx = fx if fx is not None else pm.fixed(8, 17)
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    @pm.computation
+    def predict(
+        x: pm.Argument(placement=carole, dtype=pm.float64),
+        w: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with carole:
+            xf = pm.cast(x, dtype=fx)
+        with bob:
+            wf = pm.cast(w, dtype=fx)
+        with rep:
+            score = pm.sigmoid(pm.dot(xf, wf))
+        with carole:
+            return pm.cast(score, dtype=pm.float64)
+
+    return tracer.trace(predict)
+
+
+_PREDICT_CTX = {
+    "arg_specs": {"x": (8, 4), "w": (4, 1)},
+    "arg_ranges": {"x": (-1.0, 1.0), "w": (-1.0, 1.0)},
+}
+
+
+def test_declared_clean_graph_reports_msa704_only():
+    diags = analyze(
+        _fixed_predict_graph(), analyses=["ranges"], context=_PREDICT_CTX
+    )
+    assert rules_of(diags) == {"MSA704"}, diags
+    info = [d for d in diags if d.rule == "MSA704"][0]
+    assert info.severity is Severity.INFO
+    assert "minimal ring width 64" in info.message
+
+
+def test_undeclared_graph_stays_advisory():
+    """No caller-asserted ranges -> representable-interval facts only:
+    no MSA701/702/703 judgments, just the MSA704 report."""
+    diags = analyze(_fixed_predict_graph(), analyses=["ranges"])
+    assert rules_of(diags) <= {"MSA704"}, diags
+
+
+def test_overflow_fires_msa701_with_bit_growth_chain():
+    """The acceptance pin: an MLP SGD step at fixed(24,40)-on-ring64
+    with wide declared dynamics is a compile-time error whose message
+    walks the bit-growth chain."""
+    from moose_tpu.predictors.trainers import MLPSGDTrainer
+
+    trainer = MLPSGDTrainer(
+        64, 32, fixedpoint_dtype=pm.fixed64(24, 40),
+        feature_range=(-100.0, 100.0), weight_range=(-100.0, 100.0),
+        steps_per_epoch=2,
+    )
+    with pytest.raises(MalformedComputationError) as exc_info:
+        trainer.step_computation(64)
+    diags = exc_info.value.diagnostics
+    assert any(d.rule == "MSA701" for d in diags), diags
+    msg = next(d.message for d in diags if d.rule == "MSA701")
+    assert "pre-trunc dot accumulation" in msg
+    assert "budget is 61 bits" in msg
+    assert "<=" in msg  # the chain lists per-op magnitude bounds
+
+
+def test_thin_margin_fires_msa702():
+    """A declared chain that FITS but with less headroom than the
+    requested margin warns instead of erroring."""
+    ctx = dict(_PREDICT_CTX)
+    ctx["margin_bits"] = 40.0  # absurd demand: every judged op is thin
+    diags = analyze(_fixed_predict_graph(), analyses=["ranges"],
+                    context=ctx)
+    assert "MSA702" in rules_of(diags), diags
+    assert "MSA701" not in rules_of(diags)
+    warn = [d for d in diags if d.rule == "MSA702"][0]
+    assert warn.severity is Severity.WARNING
+
+
+def test_margin_env_knob(monkeypatch):
+    monkeypatch.setenv("MOOSE_TPU_LINT_MARGIN_BITS", "40")
+    diags = analyze(_fixed_predict_graph(), analyses=["ranges"],
+                    context=_PREDICT_CTX)
+    assert "MSA702" in rules_of(diags), diags
+
+
+def test_sigmoid_domain_exit_fires_msa703():
+    """Declared sigmoid input beyond the approximation domain at
+    fixed(8,17): |x| <= ~4.85 is the representable internal domain."""
+    ctx = {
+        "arg_specs": {"x": (8, 4), "w": (4, 1)},
+        "arg_ranges": {"x": (-100.0, 100.0), "w": (-100.0, 100.0)},
+    }
+    diags = analyze(_fixed_predict_graph(), analyses=["ranges"],
+                    context=ctx)
+    assert "MSA703" in rules_of(diags), diags
+    warn = [d for d in diags if d.rule == "MSA703"][0]
+    assert warn.severity is Severity.WARNING
+    assert "sigmoid" in warn.message.lower()
+
+
+def test_range_report_values_and_summary():
+    from moose_tpu.compilation.analysis import range_report
+
+    report = range_report(_fixed_predict_graph(), **_PREDICT_CTX)
+    summary = report["summary"]
+    assert summary["fixed_values"] >= 3
+    assert summary["declared_values"] == summary["fixed_values"]
+    assert summary["min_ring_width"] == 64
+    dot = next(
+        v for name, v in report["values"].items()
+        if name.startswith("dot")
+    )
+    assert dot["kind"] == "fixed" and dot["declared"]
+    assert dot["pre_trunc_bits"] is not None
+    assert dot["hi"] >= 4.0  # k * |x| * |w| = 4
+
+
+def test_cost_report_embeds_ranges():
+    from moose_tpu.compilation.analysis import cost_report
+
+    report = cost_report(
+        _fixed_predict_graph(),
+        arg_specs=_PREDICT_CTX["arg_specs"],
+        arg_ranges=_PREDICT_CTX["arg_ranges"],
+    )
+    assert report["ranges"]["summary"]["min_ring_width"] == 64
+
+
+def test_analyze_rejects_unknown_context_key():
+    with pytest.raises(ValueError, match="unknown analysis context key"):
+        analyze(_fixed_predict_graph(), context={"bogus": 1})
+
+
+def test_context_routed_to_the_right_analysis():
+    """ranges context must not leak into cost and vice versa: a call
+    running BOTH with a merged context dict routes each key to the
+    analysis that accepts it."""
+    diags = analyze(
+        _fixed_predict_graph(), analyses=["ranges", "cost"],
+        context={**_PREDICT_CTX, "jumbo_bytes": 1},
+    )
+    assert "MSA704" in rules_of(diags), diags
+
+
+def test_cost_thresholds_env_and_context(monkeypatch):
+    comp = _networked_pair_graph()
+    baseline = analyze(comp, analyses=["cost"])
+    assert "MSA602" not in rules_of(baseline), baseline
+    # context override: a 2x2 ring128 payload dwarfs a 16-byte ceiling
+    diags = analyze(comp, analyses=["cost"], context={"jumbo_bytes": 16})
+    assert "MSA602" in rules_of(diags), diags
+    # env knob: same effect without touching call sites
+    monkeypatch.setenv("MOOSE_TPU_LINT_JUMBO_BYTES", "16")
+    diags = analyze(comp, analyses=["cost"])
+    assert "MSA602" in rules_of(diags), diags
+
+
+def _save_graph(key_value, ring):
+    """Secret-derived value persisted via Save on bob: plaintext (F64)
+    or a lowered ring share plane (Ring64 + ``#s0`` key suffix)."""
+    from moose_tpu.computation import Ty
+
+    ty = Ty("HostRing64Tensor") if ring else F64
+    comp = Computation()
+    _hosts(comp, "alice", "bob", "carole")
+    comp.add_placement(
+        ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+    )
+    comp.add_operation(Operation("x", "Input", [], "alice", SIG0,
+                                 {"arg_name": "x"}))
+    comp.add_operation(Operation(
+        "secret", "Dot", ["x", "x"], "rep", Signature((F64, F64), ty)
+    ))
+    comp.add_operation(Operation(
+        "key", "Constant", [], "bob",
+        Signature((), Ty("HostString")), {"value": key_value},
+    ))
+    comp.add_operation(Operation(
+        "sv", "Save", ["key", "secret"], "bob",
+        Signature((Ty("HostString"), ty), UnitTy),
+    ))
+    comp.add_operation(Operation(
+        "out", "Output", ["sv"], "bob", Signature((UnitTy,), UnitTy)
+    ))
+    return comp
+
+
+def test_plaintext_save_of_secret_fires_msa105():
+    diags = analyze(_save_graph("ckpt/w", ring=False),
+                    analyses=["secrecy"])
+    assert "MSA105" in rules_of(diags), diags
+    err = [d for d in diags if d.rule == "MSA105"][0]
+    assert err.severity is Severity.ERROR
+    assert "save_shares" in err.message
+
+
+def test_share_plane_save_passes_msa105():
+    """The lowered SaveShares boundary — a ring-typed share under a
+    ``#s0``/``#s1`` key — is exactly how checkpoints are SUPPOSED to
+    persist; it must stay clean."""
+    for slot in ("#s0", "#s1"):
+        diags = analyze(_save_graph(f"ckpt/w{slot}", ring=True),
+                        analyses=["secrecy"])
+        assert "MSA105" not in rules_of(diags), (slot, diags)
+
+
+def test_ring_save_without_share_key_still_fires_msa105():
+    """A ring-typed secret saved under a NON-share key is not the
+    lowering idiom — it is a leak."""
+    diags = analyze(_save_graph("ckpt/w", ring=True),
+                    analyses=["secrecy"])
+    assert "MSA105" in rules_of(diags), diags
+
+
+def test_prancer_cli_ranges_flags(tmp_path, capsys):
+    import json
+
+    from moose_tpu.bin.prancer import main
+    from moose_tpu.textual import to_textual
+
+    path = tmp_path / "predict.moose"
+    path.write_text(to_textual(_fixed_predict_graph()))
+    rc = main([
+        str(path), "--ranges", "--format", "json",
+        "--arg-shape", "x=8x4", "--arg-shape", "w=4x1",
+        "--arg-range", "x=-1:1", "--arg-range", "w=-1:1",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    report = payload["reports"][str(path)]["ranges"]
+    assert report["summary"]["min_ring_width"] == 64
+    assert report["summary"]["declared_values"] >= 3
+    # a hostile --margin-bits flips the verdict to warnings
+    rc = main([
+        str(path), "--ranges", "--margin-bits", "40",
+        "--arg-shape", "x=8x4", "--arg-shape", "w=4x1",
+        "--arg-range", "x=-1:1", "--arg-range", "w=-1:1",
+        "--strict-warnings",
+    ])
+    assert rc == 1
+    assert "MSA702" in capsys.readouterr().out
+
+
+def test_prancer_cli_arg_range_validation(tmp_path, capsys):
+    from moose_tpu.bin.prancer import _parse_arg_ranges
+
+    assert _parse_arg_ranges(["x=-1:1", "w=-2,2"]) == {
+        "x": (-1.0, 1.0), "w": (-2.0, 2.0),
+    }
+    with pytest.raises(SystemExit):
+        _parse_arg_ranges(["x=1:-1"])  # lo > hi
+    with pytest.raises(SystemExit):
+        _parse_arg_ranges(["x=abc"])
+
+
+def test_worker_plan_carries_ranges_advisory():
+    from moose_tpu.distributed import worker_plan
+
+    comp = _networked_pair_graph()
+    plan = worker_plan.get_plan(comp, "alice", session_id="ranges-adv-1")
+    assert isinstance(plan.ranges_advisory, dict)
+    assert plan.ranges_advisory.get("fixed_values") == 0
+
+
+def test_every_range_rule_is_catalogued():
+    for rule_id in ("MSA105", "MSA701", "MSA702", "MSA703", "MSA704"):
+        assert rule_id in RULES
+        assert "ranges" in ANALYSES
+
+
+def test_concat_union_tolerates_ragged_operand_ranks():
+    """Lowered serving graphs Concat planes of unequal rank (scalar
+    alongside matrices); the static shape algebra must degrade to
+    unknown shape instead of raising (regression: IndexError out of
+    ``ModelRegistry.register``)."""
+    from moose_tpu.compilation.analysis import ranges as ranges_mod
+
+    comp = _fixed_predict_graph()
+    an = ranges_mod._Analyzer(comp, None, None, None)
+    op = next(iter(comp.operations.values()))
+    matrix = ranges_mod.RangeFact(kind="float", lo=-1.0, hi=1.0,
+                                  shape=(2, 3))
+    scalar = ranges_mod.RangeFact(kind="float", lo=0.0, hi=2.0, shape=())
+    for facts in ([matrix, scalar], [scalar, matrix]):
+        fused = an._union(op, facts, concat=True)
+        assert fused.shape is None
+        assert (fused.lo, fused.hi) == (-1.0, 2.0)

@@ -1,0 +1,219 @@
+"""Dynamic soundness oracle for the MSA7xx range analysis (ISSUE 15).
+
+The static analyzer (``compilation.analysis.ranges``) predicts a
+real-space interval for every fixed-point value from the declared input
+ranges.  This suite runs the SAME graphs eagerly — per-op, logical
+dialect, deterministic PRF keys — captures every fixed-point
+intermediate (host, mirrored and replicated: shares are reconstructed
+and decoded), and asserts the measured interval is CONTAINED in the
+predicted one.  An escape here means the abstract transfer functions
+are unsound — exactly the bug class the MSA701 overflow gate cannot be
+trusted with.
+
+Covered at both shipped precisions (fixed(8,17)/ring64 and
+fixed(24,40)/ring128): logreg + MLP inference graphs and the logreg +
+MLP standalone SGD training step graphs.
+"""
+
+import contextlib
+import os
+
+import numpy as np
+import pytest
+
+# one process/trust domain: the weak default PRF is acceptable here
+# (see test_distributed.py; worker.execute_role enforces the real rule)
+os.environ.setdefault("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
+
+import moose_tpu as pm  # noqa: E402
+from moose_tpu import values as values_mod  # noqa: E402
+from moose_tpu.compilation.analysis.ranges import infer_ranges  # noqa: E402
+from moose_tpu.dialects import host as host_dialect  # noqa: E402
+from moose_tpu.dialects import logical  # noqa: E402
+from moose_tpu.edsl import tracer  # noqa: E402
+from moose_tpu.execution import interpreter as interp  # noqa: E402
+from moose_tpu.predictors.trainers import (  # noqa: E402
+    LogregSGDTrainer,
+    MLPSGDTrainer,
+)
+
+PRECISIONS = [
+    pytest.param(pm.fixed(8, 17), id="fixed(8,17)-ring64"),
+    pytest.param(pm.fixed(24, 40), id="fixed(24,40)-ring128"),
+]
+
+
+def _eager_env(comp, arguments):
+    """Run ``comp`` per-op on the logical dialect and return the full
+    op-name -> runtime-value environment (what ``_run_ops`` builds
+    internally and the plan cores normally keep private)."""
+    plan = interp.build_plan(comp, arguments, use_jit=False)
+    dyn = {}
+    for name in plan.dynamic_names:
+        op = comp.operations[name]
+        assert op.kind == "Input", f"oracle graphs take Inputs only: {op}"
+        dyn[name] = np.asarray(arguments[name])
+    sess = logical.make_session(interp.master_key_words("logical"))
+    logical.bind_placements(sess, comp)
+    env, outputs, saves = {}, {}, {}
+    seed = interp._fixed_sync_seed()
+    sync_ctx = (
+        host_dialect.deterministic_sync_keys(seed)
+        if seed is not None
+        else contextlib.nullcontext()
+    )
+    with sync_ctx:
+        interp._run_ops(
+            sess, comp, plan.order, plan.static_env, env, outputs, saves,
+            dyn,
+        )
+    return env
+
+
+def _decode_fixed(value):
+    """Decoded real values of a fixed-point runtime value, or None for
+    non-fixed values.  Replicated sharings are reconstructed (sum of the
+    three primary share planes mod 2^width) before signed decode — the
+    oracle checks the SECRET value, not the uniformly-random shares."""
+    if isinstance(value, values_mod.HostFixedTensor):
+        raws = [values_mod.to_numpy(value.tensor)]
+        width = value.tensor.width
+    elif isinstance(value, values_mod.RepFixedTensor):
+        shares = value.tensor.shares
+        raws = [values_mod.to_numpy(shares[i][0]) for i in range(3)]
+        width = shares[0][0].width
+    elif isinstance(value, values_mod.Mir3FixedTensor):
+        raws = [values_mod.to_numpy(value.tensor.values[0])]
+        width = value.tensor.values[0].width
+    else:
+        return None
+    frac = value.fractional_precision
+    total = sum(np.asarray(r).astype(object) for r in raws) % (1 << width)
+    half = 1 << (width - 1)
+    signed = [
+        int(v) - (1 << width) if int(v) >= half else int(v)
+        for v in np.ravel(total)
+    ]
+    return np.array([float(v) / float(1 << frac) for v in signed])
+
+
+def _assert_sound(comp, arguments, arg_specs, arg_ranges):
+    """Every measured fixed-point intermediate must lie inside its
+    statically predicted interval (when the fact is bounded)."""
+    env = _eager_env(comp, arguments)
+    facts = infer_ranges(comp, arg_specs=arg_specs, arg_ranges=arg_ranges)
+    checked = 0
+    for name, value in env.items():
+        decoded = _decode_fixed(value)
+        fact = facts.get(name)
+        if decoded is None or decoded.size == 0 or fact is None:
+            continue
+        if fact.kind != "fixed" or not fact.bounded:
+            continue
+        # a few extra ulps over the analyzer's own built-in slack: each
+        # trunc_pr is +/-1 LSB probabilistic, and the decode path
+        # itself rounds
+        tol = 4.0 * 2.0 ** -(fact.frac or 0)
+        lo, hi = float(decoded.min()), float(decoded.max())
+        assert lo >= fact.lo - tol and hi <= fact.hi + tol, (
+            f"{name}: measured [{lo}, {hi}] escapes predicted "
+            f"[{fact.lo}, {fact.hi}] (declared={fact.declared})"
+        )
+        checked += 1
+    assert checked >= 3, f"oracle only checked {checked} values"
+
+
+def _inference_graph(kind, fx, n_rows, n_features, hidden=3):
+    """Logreg / one-hidden-layer MLP inference at precision ``fx`` —
+    the zoo's two scoring shapes, with carole querying bob's model."""
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    if kind == "logreg":
+
+        @pm.computation
+        def predict(
+            x: pm.Argument(placement=carole, dtype=pm.float64),
+            w: pm.Argument(placement=bob, dtype=pm.float64),
+        ):
+            with carole:
+                xf = pm.cast(x, dtype=fx)
+            with bob:
+                wf = pm.cast(w, dtype=fx)
+            with rep:
+                score = pm.sigmoid(pm.dot(xf, wf))
+            with carole:
+                return pm.cast(score, dtype=pm.float64)
+
+        arg_specs = {"x": (n_rows, n_features), "w": (n_features, 1)}
+        arg_ranges = {"x": (-1.0, 1.0), "w": (-1.0, 1.0)}
+        return tracer.trace(predict), arg_specs, arg_ranges
+
+    @pm.computation
+    def predict(
+        x: pm.Argument(placement=carole, dtype=pm.float64),
+        w1: pm.Argument(placement=bob, dtype=pm.float64),
+        w2: pm.Argument(placement=bob, dtype=pm.float64),
+    ):
+        with carole:
+            xf = pm.cast(x, dtype=fx)
+        with bob:
+            w1f = pm.cast(w1, dtype=fx)
+            w2f = pm.cast(w2, dtype=fx)
+        with rep:
+            h = pm.relu(pm.dot(xf, w1f))
+            score = pm.sigmoid(pm.dot(h, w2f))
+        with carole:
+            return pm.cast(score, dtype=pm.float64)
+
+    arg_specs = {
+        "x": (n_rows, n_features),
+        "w1": (n_features, hidden),
+        "w2": (hidden, 1),
+    }
+    arg_ranges = {
+        "x": (-1.0, 1.0), "w1": (-1.0, 1.0), "w2": (-1.0, 1.0),
+    }
+    return tracer.trace(predict), arg_specs, arg_ranges
+
+
+@pytest.mark.parametrize("fx", PRECISIONS)
+@pytest.mark.parametrize("kind", ["logreg", "mlp"])
+def test_inference_measured_within_predicted(kind, fx, monkeypatch):
+    monkeypatch.setenv("MOOSE_TPU_FIXED_KEYS", "range-oracle")
+    n_rows, n_features = 8, 4
+    comp, arg_specs, arg_ranges = _inference_graph(
+        kind, fx, n_rows, n_features
+    )
+    rng = np.random.default_rng(11)
+    arguments = {
+        name: rng.uniform(lo, hi, size=arg_specs[name])
+        for name, (lo, hi) in arg_ranges.items()
+    }
+    _assert_sound(comp, arguments, arg_specs, arg_ranges)
+
+
+@pytest.mark.parametrize("fx", PRECISIONS)
+@pytest.mark.parametrize("kind", ["logreg", "mlp"])
+def test_training_step_measured_within_predicted(kind, fx, monkeypatch):
+    monkeypatch.setenv("MOOSE_TPU_FIXED_KEYS", "range-oracle")
+    n_rows, n_features = 8, 4
+    if kind == "logreg":
+        trainer = LogregSGDTrainer(
+            n_features, fixedpoint_dtype=fx, steps_per_epoch=2
+        )
+    else:
+        trainer = MLPSGDTrainer(
+            n_features, 3, fixedpoint_dtype=fx, steps_per_epoch=2
+        )
+    comp = trainer.step_computation(n_rows)
+    arg_specs, arg_ranges = trainer.range_specs(n_rows)
+    rng = np.random.default_rng(7)
+    arguments = {"x": rng.uniform(-1.0, 1.0, size=(n_rows, n_features)),
+                 "y": (rng.uniform(size=(n_rows, 1)) > 0.5).astype(
+                     np.float64)}
+    for name, shape in trainer.state_shapes.items():
+        arguments[name] = rng.uniform(-1.0, 1.0, size=shape)
+    _assert_sound(comp, arguments, arg_specs, arg_ranges)

@@ -395,3 +395,33 @@ def test_blitzen_oneshot(tmp_path):
     np.testing.assert_allclose(
         np.asarray(payload["y"]), sk.predict_proba(x), atol=5e-3
     )
+
+
+def test_register_arg_ranges_gate(fixed_keys):
+    """ISSUE 15: registration-time MSA7xx overflow gate.  Declared
+    input dynamics the fixed-point encoding cannot hold are rejected at
+    the door; sane dynamics register and serve normally."""
+    from moose_tpu.errors import MalformedComputationError
+
+    model, _ = _logreg_model()
+
+    server = InferenceServer(
+        config=ServingConfig.from_env(max_batch=2, queue_bound=8)
+    )
+    with pytest.raises(MalformedComputationError) as exc_info:
+        server.register_model(
+            "hot", model, row_shape=(6,),
+            arg_ranges={"x": (-1e15, 1e15)},
+        )
+    assert any(d.rule == "MSA701" for d in exc_info.value.diagnostics)
+    assert "hot" not in server.registry.names()
+
+    # declared unit-range inputs fit fixed(24,40)/ring128 comfortably
+    server.register_model(
+        "ok", model, row_shape=(6,), arg_ranges={"x": (-1.0, 1.0)},
+    )
+    out = server.submit("ok", RNG.uniform(-1, 1, size=(6,))).result(
+        timeout=120
+    )
+    assert np.asarray(out).shape[-1] == 2  # both class columns
+    server.close()
