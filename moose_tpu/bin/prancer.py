@@ -4,7 +4,8 @@ Runs the graph analyses of :mod:`moose_tpu.compilation.analysis` —
 secrecy/information-flow (MSA1xx), communication pairing/deadlock
 (MSA2xx), signature consistency (MSA3xx), graph hygiene (MSA4xx),
 execution-plan schedule (MSA5xx), communication/memory cost (MSA6xx),
-fixed-point value ranges (MSA7xx) —
+fixed-point value ranges (MSA7xx), PRF key lineage & stream discipline
+(MSA8xx) —
 over one or more computation files (textual ``.moose`` or msgpack, like
 the rest of the reindeer tool family) and reports every finding.  Exit
 status is 1 if any error-severity diagnostic fired (add
@@ -21,6 +22,14 @@ high-water-marks.  ``--role`` filters the report to one role;
 infer; ``--session-id`` sets the id whose length prices the transfer
 keys (byte counts depend only on its length; the client mints
 32-hex-char ids, the default).
+
+``--keystream`` emits the MSA805 key & stream report: every PRF key
+root with its generating party and holders, every seeded draw with its
+(key, nonce, domain) stream coordinates, and the per-(party, key)
+draw-count/stream-offset totals the dynamic draw oracle asserts
+against.  Logical graphs are lowered internally, which needs
+``--arg-shape`` for every Input/Load (without usable shapes the report
+says so instead of guessing).
 
 ``--ranges`` emits the MSA7xx per-value precision report (fixed-point
 intervals, raw-bit demand, minimal ring width).  ``--arg-range
@@ -41,6 +50,7 @@ Examples:
       --format json
   python -m moose_tpu.bin.prancer comp.moose --ranges \
       --arg-shape x=16x4 --arg-range x=-1:1 --arg-range w=-2:2
+  python -m moose_tpu.bin.prancer comp.moose --keystream --arg-shape x=16x4
   python -m moose_tpu.bin.prancer --explain          # rule catalogue
 """
 
@@ -146,12 +156,18 @@ def _plan_report(comp, args) -> dict:
     computation."""
     from moose_tpu.compilation.analysis import (
         cost_report,
+        keystream_report,
         range_report,
         reconstruct_schedules,
     )
     from moose_tpu.compilation.analysis.schedule import _analyzable
 
     report: dict = {}
+    if args.keystream:
+        report["keystream"] = keystream_report(
+            comp,
+            arg_specs=_parse_arg_shapes(args.arg_shape) or None,
+        )
     if args.ranges:
         # the range report works on any graph (logical or lowered) —
         # it does not need a schedulable host-level computation
@@ -161,7 +177,7 @@ def _plan_report(comp, args) -> dict:
             arg_ranges=_parse_arg_ranges(args.arg_range) or None,
         )
     if not (args.schedule or args.cost):
-        return report
+        return report  # keystream/ranges need no schedulable graph
     if not _analyzable(comp):
         report["analyzable"] = False
         return report
@@ -210,7 +226,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--analyses", default=None,
         help="comma-separated analyses to run (default: all; secrecy,"
-             "communication,signatures,hygiene,schedule,cost,ranges)",
+             "communication,signatures,hygiene,schedule,cost,ranges,"
+             "keystream)",
     )
     parser.add_argument(
         "--ignore", default=None,
@@ -267,6 +284,12 @@ def main(argv=None) -> int:
              "intervals, raw-bit demand, minimal ring width)",
     )
     parser.add_argument(
+        "--keystream", action="store_true",
+        help="emit the MSA8xx key & stream report (key roots/holders, "
+             "per-(party, key) draw counts and stream offsets — the "
+             "static side of the dynamic draw oracle)",
+    )
+    parser.add_argument(
         "--arg-range", action="append", default=None,
         metavar="NAME=LO:HI",
         help="declare a real-space bound for an Input (by name) or "
@@ -306,7 +329,9 @@ def main(argv=None) -> int:
     threshold = (
         Severity.WARNING if args.strict_warnings else Severity.ERROR
     )
-    want_report = args.schedule or args.cost or args.ranges
+    want_report = (
+        args.schedule or args.cost or args.ranges or args.keystream
+    )
     failed = False
     records = []
     reports = {}

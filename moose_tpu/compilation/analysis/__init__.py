@@ -22,8 +22,8 @@ Entry points:
 Rule id space: ``MSA1xx`` secrecy, ``MSA2xx`` communication, ``MSA3xx``
 signatures, ``MSA4xx`` hygiene, ``MSA5xx`` execution-plan schedule,
 ``MSA6xx`` communication/memory cost, ``MSA7xx`` fixed-point value
-ranges.  The full catalogue is in :data:`RULES` and documented in
-DEVELOP.md.
+ranges, ``MSA8xx`` PRF key lineage & stream discipline.  The full
+catalogue is in :data:`RULES` and documented in DEVELOP.md.
 """
 
 from __future__ import annotations
@@ -44,6 +44,13 @@ from .diagnostics import (
 )
 from .hygiene import RULES as _HYGIENE_RULES
 from .hygiene import analyze_hygiene
+from .keystream import RULES as _KEYSTREAM_RULES
+from .keystream import (
+    analyze_keystream,
+    host_draw_counts,
+    keystream_report,
+    stacked_draw_trace,
+)
 from .ranges import RULES as _RANGE_RULES
 from .ranges import RangeFact, analyze_ranges, infer_ranges, range_report
 from .schedule import RULES as _SCHEDULE_RULES
@@ -60,10 +67,12 @@ from .signatures import analyze_signatures
 
 __all__ = [
     "ANALYSES", "Diagnostic", "RULES", "RangeFact", "Severity",
-    "analyze", "analyze_cost", "analyze_ranges", "analyze_schedule",
-    "build_role_schedule", "cost_report", "format_diagnostics",
-    "infer_ranges", "infer_specs", "lint_check", "max_severity",
+    "analyze", "analyze_cost", "analyze_keystream", "analyze_ranges",
+    "analyze_schedule", "build_role_schedule", "cost_report",
+    "format_diagnostics", "host_draw_counts", "infer_ranges",
+    "infer_specs", "keystream_report", "lint_check", "max_severity",
     "plan_errors", "range_report", "reconstruct_schedules",
+    "stacked_draw_trace",
 ]
 
 # name -> analysis function; the public registry (prancer's --analyses
@@ -76,6 +85,7 @@ ANALYSES = {
     "schedule": analyze_schedule,
     "cost": analyze_cost,
     "ranges": analyze_ranges,
+    "keystream": analyze_keystream,
 }
 
 # which context keys each analysis accepts; :func:`analyze` forwards
@@ -84,12 +94,14 @@ ANALYSES = {
 ANALYSIS_CONTEXT_KEYS = {
     "ranges": ("arg_specs", "arg_ranges", "margin_bits"),
     "cost": ("jumbo_bytes", "live_buffer_bytes"),
+    "keystream": ("arg_specs",),
 }
 
 # rule id -> one-line description (prancer --explain, DEVELOP.md).
 RULES = {
     **_SECRECY_RULES, **_COMM_RULES, **_SIG_RULES, **_HYGIENE_RULES,
     **_SCHEDULE_RULES, **_COST_RULES, **_RANGE_RULES,
+    **_KEYSTREAM_RULES,
 }
 
 

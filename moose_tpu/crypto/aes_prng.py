@@ -16,15 +16,19 @@ Vendored consumption algorithm, with sources:
   ``host/ops.rs:2000``); bit draws consume one keystream byte's low bit
   each (``get_bit``, ``host/ops.rs`` bit_kernel).
 
-Layers already pinned by official vectors (``tests/test_prf_compat.py``
-against ``tests/prf_golden.json``): the AES-128 block cipher (FIPS-197)
-and blake3 (official test vectors).  The COMPOSED stream (counter
-layout + word/bit granularity above) has no Rust-extracted vectors yet
-because this environment ships no cargo toolchain; it is one command
-from closed — run ``scripts/extract_prf_golden.rs`` on any machine with
-Rust and feed its JSON to ``scripts/check_prf_golden.py``, which
-verifies every stream bit-for-bit and localizes any divergence to the
-exact consumption rule.
+Layers already pinned by official vectors (``tests/test_prf_compat.py``):
+the AES-128 block cipher (FIPS-197) and blake3 (official test vectors).
+The COMPOSED stream is frozen by the executable specification vectors in
+``moose_tpu/crypto/prf_golden.json`` — exact stream bytes per
+(seed, offset), block-boundary reads, u64/u128/bit draw orders, the
+bit-domain seed tag, and derive_seed goldens — recorded by this
+implementation and replayed every run, so any refactor that moves a
+single stream byte fails loudly.  Rust-extracted cross-vectors are
+still pending (this environment ships no cargo toolchain); it is one
+command from closed — run ``scripts/extract_prf_golden.rs`` on any
+machine with Rust and feed its JSON to ``scripts/check_prf_golden.py``,
+which verifies every stream bit-for-bit and localizes any divergence to
+the exact consumption rule.
 
 The block cipher is the repo's FIPS-197-validated numpy AES
 (``dialects/aes.py``); this module only adds the counter-mode stream
@@ -32,6 +36,8 @@ and the reference draw orders.
 """
 
 from __future__ import annotations
+
+from typing import List, Tuple
 
 import numpy as np
 
@@ -45,11 +51,11 @@ _G2_NP = np.asarray([gmul(2, b) for b in range(256)], dtype=np.uint8)
 _G3_NP = np.asarray([gmul(3, b) for b in range(256)], dtype=np.uint8)
 
 
-def _key_schedule(key: bytes) -> list:
+def _key_schedule(key: bytes) -> List[List[int]]:
     """AES-128 round keys (44 words / 11 round keys) — computed ONCE per
     RNG: the per-block schedule recomputation would dominate keystream
     generation for an unchanging key."""
-    def sub_word(w):
+    def sub_word(w: List[int]) -> List[int]:
         return [int(SBOX[b]) for b in w]
 
     words = [list(key[4 * i:4 * i + 4]) for i in range(4)]
@@ -62,7 +68,8 @@ def _key_schedule(key: bytes) -> list:
     return [sum(words[4 * r:4 * r + 4], []) for r in range(11)]
 
 
-def _encrypt_blocks(round_keys, blocks: np.ndarray) -> np.ndarray:
+def _encrypt_blocks(round_keys: List[np.ndarray],
+                    blocks: np.ndarray) -> np.ndarray:
     """Vectorized AES-128 over an (n, 16) uint8 block array with a
     precomputed schedule — numpy table lookups, one pass for the whole
     batch instead of a python loop per block."""
@@ -86,7 +93,7 @@ def _encrypt_blocks(round_keys, blocks: np.ndarray) -> np.ndarray:
 
 
 class AesCtrRng:
-    def __init__(self, seed: bytes):
+    def __init__(self, seed: bytes) -> None:
         if len(seed) != 16:
             raise ValueError("AesRng seed must be 16 bytes")
         self._key = bytes(seed)
@@ -130,7 +137,7 @@ class AesCtrRng:
         raw = self.next_bytes(8 * size)
         return np.frombuffer(raw, dtype="<u8").astype(np.uint64)
 
-    def uniform_u128(self, size: int):
+    def uniform_u128(self, size: int) -> Tuple[np.ndarray, np.ndarray]:
         """(lo, hi) u64 arrays; the reference draws the HIGH limb first
         per element ((next_u64 << 64) + next_u64, host/ops.rs:2000)."""
         raw = np.frombuffer(

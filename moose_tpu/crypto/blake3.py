@@ -16,6 +16,7 @@ SHA-256 IV, 16-word message permutation).
 from __future__ import annotations
 
 import struct
+from typing import List, Optional, Sequence, Tuple
 
 IV = (
     0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
@@ -40,7 +41,8 @@ def _rotr(x: int, n: int) -> int:
     return ((x >> n) | (x << (32 - n))) & _MASK
 
 
-def _g(state, a, b, c, d, mx, my):
+def _g(state: List[int], a: int, b: int, c: int, d: int,
+       mx: int, my: int) -> None:
     state[a] = (state[a] + state[b] + mx) & _MASK
     state[d] = _rotr(state[d] ^ state[a], 16)
     state[c] = (state[c] + state[d]) & _MASK
@@ -51,7 +53,8 @@ def _g(state, a, b, c, d, mx, my):
     state[b] = _rotr(state[b] ^ state[c], 7)
 
 
-def _compress(cv, block_words, counter, block_len, flags):
+def _compress(cv: Sequence[int], block_words: Sequence[int],
+              counter: int, block_len: int, flags: int) -> List[int]:
     state = [
         cv[0], cv[1], cv[2], cv[3], cv[4], cv[5], cv[6], cv[7],
         IV[0], IV[1], IV[2], IV[3],
@@ -72,11 +75,11 @@ def _compress(cv, block_words, counter, block_len, flags):
     return state
 
 
-def _words(block: bytes):
+def _words(block: bytes) -> Tuple[int, ...]:
     return struct.unpack("<16I", block.ljust(BLOCK_LEN, b"\x00"))
 
 
-def _chunk_blocks(chunk: bytes):
+def _chunk_blocks(chunk: bytes) -> List[Tuple[bytes, int]]:
     """Yield (block_bytes, block_len) for one chunk; an empty chunk is a
     single zero-length block (the spec's empty-input convention)."""
     if not chunk:
@@ -91,14 +94,15 @@ def _chunk_blocks(chunk: bytes):
 class _Output:
     """Pending root output: re-compressible at any XOF block counter."""
 
-    def __init__(self, cv, block_words, counter, block_len, flags):
+    def __init__(self, cv: Sequence[int], block_words: Sequence[int],
+                 counter: int, block_len: int, flags: int) -> None:
         self.cv = cv
         self.block_words = block_words
         self.counter = counter
         self.block_len = block_len
         self.flags = flags
 
-    def chaining_value(self):
+    def chaining_value(self) -> Tuple[int, ...]:
         st = _compress(
             self.cv, self.block_words, self.counter, self.block_len,
             self.flags,
@@ -120,7 +124,8 @@ class _Output:
         return bytes(out[:n])
 
 
-def _chunk_output(chunk: bytes, key_words, chunk_counter: int, flags: int):
+def _chunk_output(chunk: bytes, key_words: Sequence[int],
+                  chunk_counter: int, flags: int) -> _Output:
     cv = tuple(key_words)
     blocks = _chunk_blocks(chunk)
     for i, (b, blen) in enumerate(blocks[:-1]):
@@ -132,13 +137,15 @@ def _chunk_output(chunk: bytes, key_words, chunk_counter: int, flags: int):
     return _Output(cv, _words(b), chunk_counter, blen, f)
 
 
-def _parent_output(left_cv, right_cv, key_words, flags):
+def _parent_output(left_cv: Sequence[int], right_cv: Sequence[int],
+                   key_words: Sequence[int], flags: int) -> _Output:
     block = struct.pack("<8I", *left_cv) + struct.pack("<8I", *right_cv)
     return _Output(tuple(key_words), _words(block), 0, BLOCK_LEN,
                    flags | PARENT)
 
 
-def _hash_tree(data: bytes, key_words, flags: int) -> _Output:
+def _hash_tree(data: bytes, key_words: Sequence[int],
+               flags: int) -> _Output:
     chunks = [
         data[i:i + CHUNK_LEN] for i in range(0, len(data), CHUNK_LEN)
     ] or [b""]
@@ -146,7 +153,7 @@ def _hash_tree(data: bytes, key_words, flags: int) -> _Output:
         return _chunk_output(chunks[0], key_words, 0, flags)
     # left-leaning binary tree over chunk chaining values (left subtree
     # is the largest power-of-two number of chunks)
-    def subtree(lo: int, hi: int) -> tuple:
+    def subtree(lo: int, hi: int) -> Tuple[int, ...]:
         if hi - lo == 1:
             return _chunk_output(chunks[lo], key_words, lo, flags)\
                 .chaining_value()
@@ -166,7 +173,7 @@ def _hash_tree(data: bytes, key_words, flags: int) -> _Output:
     return _parent_output(left, right, key_words, flags)
 
 
-def blake3(data: bytes, key: bytes = None, flags: int = 0,
+def blake3(data: bytes, key: Optional[bytes] = None, flags: int = 0,
            out_len: int = 32) -> bytes:
     """BLAKE3 hash / keyed hash / XOF.  ``key`` (32 bytes) selects keyed
     mode; ``flags`` is used internally by :func:`derive_key`."""

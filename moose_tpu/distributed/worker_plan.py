@@ -606,6 +606,13 @@ _verdict_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 # demand; it never rejects a plan).
 _ranges_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
+# MSA8xx verdict per computation: key-lineage errors (mis-wired setup,
+# missing domain separation, stream-position reuse) are correctness
+# *and* secrecy bugs, so like the MSA5xx schedule verdict they reject
+# the plan rather than advise.  Weak-keyed: serving traffic replays the
+# same computation object thousands of times.
+_keystream_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 
 def _ranges_advisory(comp) -> dict:
     """The range analysis' per-computation summary (peak raw-bit demand,
@@ -626,6 +633,28 @@ def _ranges_advisory(comp) -> dict:
     with _cache_lock:
         _ranges_cache[comp] = advisory
     return advisory
+
+
+def _keystream_errors(comp) -> list:
+    """Error-severity MSA8xx findings for ``comp`` (worker graphs are
+    already lowered, so the analyzer sees the real Sample/DeriveSeed
+    ops directly).  An analysis *crash* must never take down plan
+    building — only a clean run that found real errors rejects."""
+    with _cache_lock:
+        cached = _keystream_cache.get(comp)
+    if cached is not None:
+        return cached
+    try:
+        from ..compilation.analysis import Severity
+        from ..compilation.analysis.keystream import analyze_keystream
+
+        errors = [d for d in analyze_keystream(comp)
+                  if d.severity >= Severity.ERROR]
+    except Exception:  # noqa: BLE001 — fail open, like _ranges_advisory
+        errors = []
+    with _cache_lock:
+        _keystream_cache[comp] = errors
+    return errors
 
 
 def _schedule_errors(comp) -> list:
@@ -674,6 +703,24 @@ def get_plan(comp, identity: str,
             f"schedule analyzer with {len(errors)} error(s):\n"
             + format_diagnostics(errors),
             diagnostics=errors,
+        )
+    key_errors = _keystream_errors(comp)
+    if key_errors:
+        from ..compilation.analysis.diagnostics import format_diagnostics
+
+        _stat("plans_rejected")
+        from .. import flight
+
+        flight.record(
+            "plan_rejected", party=identity, session=session_id,
+            rules=sorted({d.rule for d in key_errors}),
+            findings=len(key_errors),
+        )
+        raise PlanRejectedError(
+            f"worker plan for role {identity!r} rejected by the "
+            f"keystream analyzer with {len(key_errors)} error(s):\n"
+            + format_diagnostics(key_errors),
+            diagnostics=key_errors,
         )
     plan = RolePlan(comp, identity)
     plan.ranges_advisory = _ranges_advisory(comp)

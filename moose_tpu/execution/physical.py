@@ -103,7 +103,9 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
         # (domain = op name so fixed-keys mode gives DISTINCT keys per op)
         import jax.numpy as jnp
 
-        return HostPrfKey(jnp.asarray(_fresh_key_words(op.name)), plc)
+        return HostPrfKey(
+            jnp.asarray(_fresh_key_words(op.name)), plc, origin=op.name
+        )
     if kind == "DeriveSeed":
         return sess.derive_seed(plc, args[0], A["sync_key"])
     if kind == "SampleSeeded":
@@ -114,7 +116,10 @@ def execute_kernel(sess: EagerSession, op, plc: str, args: list):
         # (_run_physical_ops)
         import jax.numpy as jnp
 
-        seed = HostSeed(jnp.asarray(_fresh_key_words(op.name)), plc)
+        seed = HostSeed(
+            jnp.asarray(_fresh_key_words(op.name)), plc,
+            origin=(("fresh", op.name), None),
+        )
         return _sample_from_seed(sess, plc, args[0], seed, ret.name, A)
     if kind == "Add":
         return sess.add(plc, args[0], args[1])
@@ -320,7 +325,7 @@ def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
             env[n] = host.place(env[recv_src[n]], plc)
             continue
         if op.kind == "PrfKeyGen":
-            env[n] = HostPrfKey(jnp.asarray(keys[n]), plc)
+            env[n] = HostPrfKey(jnp.asarray(keys[n]), plc, origin=n)
             continue
         if op.kind == "Sample":
             # unseeded draw (reference SampleOp): fresh 128-bit seed per
@@ -328,7 +333,8 @@ def _run_physical_ops(sess, comp, names, static_env, env, outputs, saves,
             # stays reusable
             env[n] = _sample_from_seed(
                 sess, plc, env[op.inputs[0]],
-                HostSeed(jnp.asarray(keys[n]), plc),
+                HostSeed(jnp.asarray(keys[n]), plc,
+                         origin=(("fresh", n), None)),
                 op.signature.return_type.name, op.attributes,
             )
             continue

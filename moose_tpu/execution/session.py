@@ -26,6 +26,7 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..dialects import host
+from . import drawledger
 from ..values import (
     HostBitTensor,
     HostFixedTensor,
@@ -100,20 +101,31 @@ class EagerSession:
             [idx, 0x6B657921 ^ self._key_domain, idx ^ 0xDEADBEEF, 1],
             np.uint32,
         )
-        return HostPrfKey(ring.mix_seed(self._master, nonce), plc)
-
-    def derive_seed(self, plc: str, key: HostPrfKey, sync_key: bytes) -> HostSeed:
-        return host.derive_seed(
-            key, sync_key, plc, session_id=self.session_id
+        # origin = session key index: the i-th eager key_gen corresponds
+        # to the i-th PrfKeyGen the symbolic lowering emits (same dialect
+        # code, same walk order), which is what lets the draw oracle match
+        # runtime draws to the static per-(party, key) report.
+        return HostPrfKey(
+            ring.mix_seed(self._master, nonce), plc, origin=("key", idx)
         )
 
+    def derive_seed(self, plc: str, key: HostPrfKey, sync_key: bytes) -> HostSeed:
+        seed = host.derive_seed(
+            key, sync_key, plc, session_id=self.session_id
+        )
+        seed.origin = (getattr(key, "origin", None), sync_key)
+        return seed
+
     def sample_uniform_seeded(self, plc, shp, seed, width: int):
+        drawledger.record_host_draw(plc, seed, "ring", shp.value, width)
         return host.sample_uniform_seeded(shp, seed, width, plc)
 
     def sample_bits_seeded(self, plc, shp, seed, width: int):
+        drawledger.record_host_draw(plc, seed, "bits", shp.value, width)
         return host.sample_bits_seeded(shp, seed, width, plc)
 
     def sample_bit_tensor_seeded(self, plc, shp, seed):
+        drawledger.record_host_draw(plc, seed, "bit_tensor", shp.value, None)
         return host.sample_bit_tensor_seeded(shp, seed, plc)
 
     # -- value movement ----------------------------------------------------

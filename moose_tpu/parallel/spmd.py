@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..dialects import ring
+from ..execution import drawledger as _ledger
 from ..native import ring128_kernels as _rk
 
 U64 = jnp.uint64
@@ -92,6 +93,74 @@ def derive_step_keys(master_key, n: int, salt: int = 0x9E3779B9):
     )
 
 
+def _ambient_mesh():
+    """The mesh installed by ``with mesh:`` at trace time, or None."""
+    from jax.interpreters import pxla
+
+    mesh = pxla.thread_resources.env.physical_mesh
+    if mesh is None or mesh.empty:
+        return None
+    return mesh
+
+
+def _pin_contract_rhs() -> bool:
+    """Whether to pin the second operand of a secure dot/conv replicated.
+
+    XLA's CPU SPMD partitioner miscompiles programs that feed one
+    partially-sharded and one unconstrained u64 operand into a batched
+    ``dot_general`` and also combine the unconstrained operand elsewhere
+    (the pair-sum): the contraction reads corrupted values.  Repro in
+    ``tests/test_spmd.py::test_sharded_dot_mixed_consumer_repro`` (jax
+    0.4.37, 12 virtual CPU devices) — the PRF-drawn share banks are part
+    of the trigger; a constants-only reduction compiles correctly, so
+    the repro drives the real fx_dot path.  Pinning the rhs share slices to the
+    replicated sharding gives the partitioner one explicit layout and
+    restores exactness, while the lhs keeps its batch sharding so the
+    contraction still partitions over the data axis.  Applied on the CPU
+    backend (where the miscompile reproduces); MOOSE_TPU_SPMD_PIN=
+    always|never overrides for A/B on other backends."""
+    import os as _os_
+
+    knob = _os_.environ.get("MOOSE_TPU_SPMD_PIN", "auto")
+    if knob == "always":
+        return True
+    if knob == "never":
+        return False
+    return jax.default_backend() == "cpu"
+
+
+def _pin_replicated(*arrays):
+    """Pin PRF outputs to a fully-replicated sharding under an ambient mesh.
+
+    Inside a jitted program whose values carry sharding constraints, GSPMD
+    is free to materialize a cheap producer once per consumer sharding
+    instead of resharding one copy.  For ordinary pure ops that is sound,
+    but the PRF expansion ops (``RngBitGenerator``, and the threefry
+    custom-call on CPU) are only deterministic per materialization — two
+    differently-partitioned copies of the same logical draw yield
+    DIFFERENT bits, so a mask drawn once and consumed twice (every secret
+    share: x2 = x - x0 - x1 with x0/x1 re-emitted as share slices) silently
+    stops cancelling and reconstruction returns uniform garbage.  Observed
+    on (parties, data) meshes with data > 1 (tests/test_spmd.py mesh
+    sweep).  Pinning the draw itself to the replicated sharding gives the
+    partitioner exactly one layout for every copy, which restores
+    bit-identical masks on every consumer path; downstream resharding is
+    then plain data movement, which GSPMD handles soundly."""
+    from jax.interpreters import pxla
+
+    mesh = pxla.thread_resources.env.physical_mesh
+    if mesh is None or mesh.empty:
+        return arrays if len(arrays) > 1 else arrays[0]
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()
+    )
+    pinned = tuple(
+        None if a is None else jax.lax.with_sharding_constraint(a, sharding)
+        for a in arrays
+    )
+    return pinned if len(pinned) > 1 else pinned[0]
+
+
 class SpmdSession:
     """Derives all per-invocation randomness from one master key.
 
@@ -99,7 +168,9 @@ class SpmdSession:
     in one RngBitGenerator call.  Party i's slice is exactly the stream it
     would derive from pairwise PRF keys in the per-host layout; sharding the
     leading axis over the party mesh axis keeps each slice resident on its
-    party's devices.
+    party's devices.  Under an ambient device mesh every draw is pinned
+    replicated (:func:`_pin_replicated`) so the partitioner can never
+    duplicate a PRF op into inconsistent per-sharding copies.
     """
 
     def __init__(self, master_key, domain: int = 0):
@@ -127,19 +198,23 @@ class SpmdSession:
 
     def sample_bank(self, shape, width: int):
         """(3, *shape) uniform ring elements, one per party."""
+        _ledger.record_stacked_draw("bank", shape, width)
         seed = self._next_seed()
         lo, hi = ring.sample_uniform_seeded((3,) + tuple(shape), seed, width)
-        return lo, hi
+        return _pin_replicated(lo, hi)
 
     def sample(self, shape, width: int):
+        _ledger.record_stacked_draw("sample", shape, width)
         seed = self._next_seed()
-        return ring.sample_uniform_seeded(tuple(shape), seed, width)
+        lo, hi = ring.sample_uniform_seeded(tuple(shape), seed, width)
+        return _pin_replicated(lo, hi)
 
     def sample_bit_bank(self, shape):
         """(3, *shape) uniform bits as uint8 0/1, one slice per party."""
+        _ledger.record_stacked_draw("bit_bank", shape, None)
         seed = self._next_seed()
         lo, _ = ring.sample_bits_seeded((3,) + tuple(shape), seed, 64)
-        return lo.astype(jnp.uint8)
+        return _pin_replicated(lo.astype(jnp.uint8))
 
 
 # ---------------------------------------------------------------------------
@@ -236,6 +311,18 @@ def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
 
     x0, y0 = take(x, 0), take(y, 0)
     x1, y1 = take(x, 1), take(y, 1)
+    if (
+        contract is not ring.mul
+        and _ambient_mesh() is not None
+        and _pin_contract_rhs()
+    ):
+        # See _pin_contract_rhs: replicate the second operand's share
+        # slices so the partitioner never mixes an unconstrained u64
+        # operand into the batched contraction (CPU miscompile guard).
+        x0 = _pin_replicated(*x0)
+        x1 = _pin_replicated(*x1)
+        y0 = _pin_replicated(*y0)
+        y1 = _pin_replicated(*y1)
     if contract is ring.mul and _rk.dispatch("cross_terms_mul", x.width):
         try:
             return _rk.cross_terms_mul(x0, x1, y0, y1, x.width)

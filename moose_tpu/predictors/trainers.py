@@ -138,15 +138,18 @@ class SecureTrainer(predictor.Predictor):
         return arg_specs, arg_ranges
 
     def _range_lint(self, comp, n_rows: int = None):
-        """Build-time MSA7xx gate: every trainer graph is linted against
-        the trainer's declared ranges the moment it is traced, so a
-        fixed-point config that cannot hold the declared training
-        dynamics fails at build time with the bit-growth chain."""
+        """Build-time MSA7xx+MSA8xx gate: every trainer graph is linted
+        the moment it is traced — against the trainer's declared ranges
+        (a fixed-point config that cannot hold the declared training
+        dynamics fails at build time with the bit-growth chain) and
+        against the keystream discipline (the same arg specs let the
+        analyzer lower the graph and audit key topology and stream
+        positions before a single secret is shared)."""
         from ..compilation.analysis import lint_check
 
         arg_specs, arg_ranges = self.range_specs(n_rows)
         lint_check(
-            comp, analyses=["ranges"],
+            comp, analyses=["ranges", "keystream"],
             context={"arg_specs": arg_specs, "arg_ranges": arg_ranges},
         )
         return comp

@@ -61,10 +61,16 @@ class HostShape:
 @dataclasses.dataclass
 class HostSeed:
     """128-bit seed (reference HostSeed).  Carried as a uint32[4] array so
-    seed derivation stays on-device and jittable."""
+    seed derivation stays on-device and jittable.
+
+    ``origin`` is provenance metadata for the keystream draw oracle: the
+    ``(key origin, sync_key)`` pair the seed was derived from (set by the
+    sessions; None for seeds minted outside instrumented paths).  It never
+    influences execution."""
 
     value: Any  # uint32[4]
     plc: str
+    origin: Any = None
 
     def ty_name(self) -> str:
         return "HostSeed"
@@ -72,8 +78,13 @@ class HostSeed:
 
 @dataclasses.dataclass
 class HostPrfKey:
+    """PRF key words (uint32[4]).  ``origin`` is draw-oracle provenance —
+    the PrfKeyGen op name or session key index that minted the key; it
+    never influences execution."""
+
     value: Any  # uint32[4]
     plc: str
+    origin: Any = None
 
     def ty_name(self) -> str:
         return "HostPrfKey"

@@ -21,6 +21,10 @@ ROOT = pathlib.Path(__file__).resolve().parents[1]
 TARGETS = [
     "moose_tpu/compilation/analysis",
     "moose_tpu/training",
+    # the PRF construction the keystream analysis (MSA8xx) models —
+    # drift between the two is a silent-secrecy bug, so both sides of
+    # the contract sit under the same gate
+    "moose_tpu/crypto",
 ]
 
 

@@ -451,10 +451,10 @@ def test_ndarray_attributes_are_structurally_compared():
 def test_every_rule_is_catalogued():
     assert set(ANALYSES) == {
         "secrecy", "communication", "signatures", "hygiene",
-        "schedule", "cost", "ranges",
+        "schedule", "cost", "ranges", "keystream",
     }
     assert {r[:4] for r in RULES} == {
-        "MSA1", "MSA2", "MSA3", "MSA4", "MSA5", "MSA6", "MSA7"
+        "MSA1", "MSA2", "MSA3", "MSA4", "MSA5", "MSA6", "MSA7", "MSA8"
     }
 
 

@@ -1,0 +1,381 @@
+"""Keystream analysis (MSA8xx) pins: one deliberately mis-wired graph
+per rule — a setup key crossing to the wrong cycle neighbour (MSA801,
+declared and inferred), an untagged bit draw sharing a ring seed
+(MSA802), two samples consuming one stream position (MSA803), a
+one-time mask feeding two independent openings (MSA804) — plus the
+clean twins that must stay silent, the lineage-chain formatting, the
+analyze()/lint_check registration, and the worker-plan keystream gate.
+"""
+
+import pytest
+
+from moose_tpu.compilation.analysis import (
+    ANALYSES,
+    RULES,
+    Severity,
+    analyze,
+    lint_check,
+)
+from moose_tpu.compilation.analysis.keystream import (
+    analyze_keystream,
+    keystream_report,
+)
+from moose_tpu.computation import (
+    Computation,
+    HostBitTensorTy,
+    HostPlacement,
+    HostRing64TensorTy,
+    Operation,
+    PrfKeyTy,
+    ReplicatedPlacement,
+    SeedTy,
+    ShapeTy,
+    Signature,
+    UnitTy,
+)
+from moose_tpu.errors import MalformedComputationError
+
+KEYGEN = Signature((), PrfKeyTy)
+KEYMOVE = Signature((PrfKeyTy,), PrfKeyTy)
+DERIVE = Signature((PrfKeyTy,), SeedTy)
+SEEDMOVE = Signature((SeedTy,), SeedTy)
+SHAPE = Signature((), ShapeTy)
+SAMPLE_R = Signature((ShapeTy, SeedTy), HostRing64TensorTy)
+SAMPLE_B = Signature((ShapeTy, SeedTy), HostBitTensorTy)
+RING1 = Signature((HostRing64TensorTy,), HostRing64TensorTy)
+RING2 = Signature((HostRing64TensorTy,) * 2, HostRing64TensorTy)
+SEND = Signature((HostRing64TensorTy,), UnitTy)
+RECV = Signature((), HostRing64TensorTy)
+
+
+def _base(*, rep: bool = False) -> Computation:
+    comp = Computation()
+    for n in ("alice", "bob", "carole"):
+        comp.add_placement(HostPlacement(n))
+    if rep:
+        comp.add_placement(
+            ReplicatedPlacement("rep", ("alice", "bob", "carole"))
+        )
+    comp.add_operation(
+        Operation("shp", "Constant", [], "alice", SHAPE, {"value": (4,)})
+    )
+    return comp
+
+
+def _key(comp, name, plc):
+    comp.add_operation(Operation(name, "PrfKeyGen", [], plc, KEYGEN))
+
+
+def _move_key(comp, name, src, plc):
+    comp.add_operation(Operation(name, "Identity", [src], plc, KEYMOVE))
+
+
+def _seed(comp, name, key, plc, sync=b"s0"):
+    comp.add_operation(
+        Operation(name, "DeriveSeed", [key], plc, DERIVE,
+                  {"sync_key": sync})
+    )
+
+
+def _draw(comp, name, seed, plc, *, bit=False, tagged=False):
+    attrs = {"max_value": 1} if tagged else {}
+    sig = SAMPLE_B if bit else SAMPLE_R
+    comp.add_operation(
+        Operation(name, "SampleSeeded", ["shp", seed], plc, sig, attrs)
+    )
+
+
+def rules_of(diags):
+    return {d.rule for d in diags}
+
+
+def errors_of(diags):
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# MSA801 — replicated setup key topology
+# ---------------------------------------------------------------------------
+
+
+def test_msa801_declared_cycle_wrong_neighbour():
+    """In cycle (alice, bob, carole) a key generated at alice may only
+    be co-held by carole; a copy at bob is a mis-wired setup."""
+    comp = _base(rep=True)
+    _key(comp, "k0", "alice")
+    _move_key(comp, "k0_at_bob", "k0", "bob")
+    diags = analyze_keystream(comp)
+    msa801 = [d for d in diags if d.rule == "MSA801"]
+    assert len(msa801) == 1
+    assert msa801[0].severity == Severity.ERROR
+    assert "carole" in msa801[0].message  # names the expected co-holder
+    # the lineage chain walks the copy back to the generator
+    assert "PrfKeyGen@alice" in msa801[0].message
+
+
+def test_msa801_declared_cycle_correct_neighbour_clean():
+    comp = _base(rep=True)
+    _key(comp, "k0", "alice")
+    _move_key(comp, "k0_at_carole", "k0", "carole")
+    assert "MSA801" not in rules_of(analyze_keystream(comp))
+
+
+def test_msa801_key_on_three_parties():
+    """A pairwise PRF key held by all three parties makes every
+    'unknown to one party' argument vacuous — error even without a
+    declared cycle."""
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _move_key(comp, "k0_at_bob", "k0", "bob")
+    _move_key(comp, "k0_at_carole", "k0", "carole")
+    msa801 = [d for d in analyze_keystream(comp) if d.rule == "MSA801"]
+    assert len(msa801) == 1
+    assert "at most two parties" in msa801[0].message
+
+
+def test_msa801_inferred_cycle_two_foreign_generators():
+    """Without a declared ReplicatedPlacement (lowered graphs keep only
+    hosts), a party holding foreign keys from two distinct generators
+    cannot be the (k_i, k_{i+1}) corner of any consistent 3-cycle."""
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _key(comp, "k1", "bob")
+    _move_key(comp, "k0_x", "k0", "carole")
+    _move_key(comp, "k1_x", "k1", "carole")
+    msa801 = [d for d in analyze_keystream(comp) if d.rule == "MSA801"]
+    assert len(msa801) == 1
+    assert "carole" in msa801[0].message
+
+
+def test_msa801_inferred_cycle_consistent_clean():
+    """The healthy replicated setup — each party's key crosses to
+    exactly one distinct neighbour — stays silent."""
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _key(comp, "k1", "bob")
+    _key(comp, "k2", "carole")
+    _move_key(comp, "k0_x", "k0", "carole")
+    _move_key(comp, "k1_x", "k1", "alice")
+    _move_key(comp, "k2_x", "k2", "bob")
+    assert "MSA801" not in rules_of(analyze_keystream(comp))
+
+
+# ---------------------------------------------------------------------------
+# MSA802 — bit/ring domain separation
+# ---------------------------------------------------------------------------
+
+
+def test_msa802_untagged_bit_draw_shares_ring_seed():
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "ring0", "s0", "alice")
+    comp.add_operation(
+        Operation("s0_at_bob", "Identity", ["s0"], "bob", SEEDMOVE)
+    )
+    _draw(comp, "bits0", "s0_at_bob", "bob", bit=True, tagged=False)
+    diags = analyze_keystream(comp)
+    msa802 = [d for d in diags if d.rule == "MSA802"]
+    assert len(msa802) == 1
+    assert msa802[0].severity == Severity.ERROR
+    assert msa802[0].op == "bits0"
+    assert "ring0" in msa802[0].message
+    # distinct placements: no stream-position reuse on top
+    assert "MSA803" not in rules_of(diags)
+
+
+def test_msa802_tagged_bit_draw_clean():
+    """The bit-domain tag (max_value: 1) IS the domain separation —
+    tagged bit draws may share a seed with ring draws."""
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "ring0", "s0", "alice")
+    comp.add_operation(
+        Operation("s0_at_bob", "Identity", ["s0"], "bob", SEEDMOVE)
+    )
+    _draw(comp, "bits0", "s0_at_bob", "bob", bit=True, tagged=True)
+    assert "MSA802" not in rules_of(analyze_keystream(comp))
+
+
+# ---------------------------------------------------------------------------
+# MSA803 — stream-position reuse
+# ---------------------------------------------------------------------------
+
+
+def test_msa803_two_draws_same_stream():
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "draw_a", "s0", "alice")
+    _draw(comp, "draw_b", "s0", "alice")
+    msa803 = [d for d in analyze_keystream(comp) if d.rule == "MSA803"]
+    assert len(msa803) == 1
+    assert msa803[0].severity == Severity.ERROR
+    msg = msa803[0].message
+    assert "draw_a" in msg and "draw_b" in msg
+    # readable lineage chains: draw <- seed <- key
+    assert "DeriveSeed@alice" in msg and "PrfKeyGen@alice" in msg
+    assert "sync=" in msg
+
+
+def test_msa803_cross_party_repetition_exempt():
+    """Two parties consuming the same (key, nonce) stream is the PRF
+    compression replicated protocols rely on — never flagged."""
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "draw_a", "s0", "alice")
+    comp.add_operation(
+        Operation("s0_at_bob", "Identity", ["s0"], "bob", SEEDMOVE)
+    )
+    _draw(comp, "draw_b", "s0_at_bob", "bob")
+    assert "MSA803" not in rules_of(analyze_keystream(comp))
+
+
+def test_msa803_distinct_sync_keys_clean():
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice", sync=b"s0")
+    _seed(comp, "s1", "k0", "alice", sync=b"s1")
+    _draw(comp, "draw_a", "s0", "alice")
+    _draw(comp, "draw_b", "s1", "alice")
+    assert "MSA803" not in rules_of(analyze_keystream(comp))
+
+
+# ---------------------------------------------------------------------------
+# MSA804 — mask / opening discipline
+# ---------------------------------------------------------------------------
+
+
+def _mask_graph(*, reconstruct: bool) -> Computation:
+    """carole masks two different constants with ONE sampled mask and
+    sends both to alice.  With ``reconstruct=False`` alice consumes
+    them independently (mask cancels under subtraction: leak); with
+    ``reconstruct=True`` alice linearly combines them into one value —
+    the deliberate share reconstruction every reveal performs."""
+    comp = _base()
+    _key(comp, "k0", "carole")
+    _seed(comp, "s0", "k0", "carole")
+    _draw(comp, "mask", "s0", "carole")
+    for name, value in (("a", 1), ("b", 2)):
+        comp.add_operation(
+            Operation(name, "Constant", [], "carole",
+                      Signature((), HostRing64TensorTy), {"value": value})
+        )
+    comp.add_operation(
+        Operation("m1", "Sub", ["a", "mask"], "carole", RING2)
+    )
+    comp.add_operation(
+        Operation("m2", "Sub", ["b", "mask"], "carole", RING2)
+    )
+    for i, src in enumerate(("m1", "m2")):
+        comp.add_operation(Operation(
+            f"send{i}", "Send", [src], "carole", SEND,
+            {"rendezvous_key": f"rk{i}", "receiver": "alice"},
+        ))
+        comp.add_operation(Operation(
+            f"recv{i}", "Receive", [], "alice", RECV,
+            {"rendezvous_key": f"rk{i}", "sender": "carole"},
+        ))
+    if reconstruct:
+        comp.add_operation(
+            Operation("sum", "Add", ["recv0", "recv1"], "alice", RING2)
+        )
+        comp.add_operation(
+            Operation("use", "Mul", ["sum", "sum"], "alice", RING2)
+        )
+    else:
+        comp.add_operation(
+            Operation("use0", "Mul", ["recv0", "recv0"], "alice", RING2)
+        )
+        comp.add_operation(
+            Operation("use1", "Mul", ["recv1", "recv1"], "alice", RING2)
+        )
+    return comp
+
+
+def test_msa804_shared_mask_two_openings():
+    diags = analyze_keystream(_mask_graph(reconstruct=False))
+    msa804 = [d for d in diags if d.rule == "MSA804"]
+    assert len(msa804) == 1
+    assert msa804[0].severity == Severity.WARNING
+    msg = msa804[0].message
+    assert "m1" in msg and "m2" in msg and "alice" in msg
+    assert "SampleSeeded@carole" in msg  # mask lineage chain
+
+
+def test_msa804_reconstruction_exempt():
+    """Linearly combining everything received back into one value is a
+    single opening of a single logical value, not mask reuse."""
+    assert "MSA804" not in rules_of(
+        analyze_keystream(_mask_graph(reconstruct=True))
+    )
+
+
+# ---------------------------------------------------------------------------
+# MSA805 — draw report, registration, gates
+# ---------------------------------------------------------------------------
+
+
+def _clean_graph() -> Computation:
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "draw_a", "s0", "alice")
+    return comp
+
+
+def test_msa805_report_contents():
+    diags = analyze_keystream(_clean_graph())
+    msa805 = [d for d in diags if d.rule == "MSA805"]
+    assert len(msa805) == 1
+    assert msa805[0].severity == Severity.INFO
+    assert "1 keys" in msa805[0].message
+
+    report = keystream_report(_clean_graph())
+    assert report["analyzed"] is True
+    assert [k["label"] for k in report["keys"]] == ["key:0"]
+    assert report["per_party_key"]["alice|key:0"]["draws"] == 1
+
+
+def test_registry_and_rule_catalogue():
+    assert "keystream" in ANALYSES
+    for rule in ("MSA801", "MSA802", "MSA803", "MSA804", "MSA805"):
+        assert rule in RULES
+    # analyze() routes to the keystream analyzer by name
+    diags = analyze(_clean_graph(), analyses=["keystream"])
+    assert rules_of(diags) == {"MSA805"}
+
+
+def test_lint_check_raises_on_stream_reuse():
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "draw_a", "s0", "alice")
+    _draw(comp, "draw_b", "s0", "alice")
+    with pytest.raises(MalformedComputationError) as exc:
+        lint_check(comp, analyses=["keystream"])
+    assert "MSA803" in str(exc.value)
+
+
+def test_worker_plan_keystream_gate():
+    """get_plan rejects a graph with key-lineage errors the same way it
+    rejects would-hang schedules: a typed PlanRejectedError at build
+    time, never a silently weakened session."""
+    import moose_tpu.distributed.worker_plan as wp
+    from moose_tpu.errors import PlanRejectedError
+
+    comp = _base()
+    _key(comp, "k0", "alice")
+    _seed(comp, "s0", "k0", "alice")
+    _draw(comp, "draw_a", "s0", "alice")
+    _draw(comp, "draw_b", "s0", "alice")
+    comp.add_operation(
+        Operation("out", "Output", ["draw_a"], "alice", RING1)
+    )
+    assert [d.rule for d in wp._keystream_errors(comp)] == ["MSA803"]
+    with pytest.raises(PlanRejectedError) as exc:
+        wp.get_plan(comp, "alice")
+    assert "keystream analyzer" in str(exc.value)
+    assert "MSA803" in str(exc.value)

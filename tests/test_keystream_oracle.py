@@ -1,0 +1,236 @@
+"""Dynamic draw oracle: the MSA805 static draw report must equal what
+the runtime ACTUALLY draws.  Per-host (eager) runs compare the
+per-(party, key) draw/element counts against the draw ledger; stacked
+runs compare the full ordered draw trace (kind, width, elems) against a
+shape-domain abstract interpretation of the compiled plan.  The matrix
+covers logreg and MLP, inference and training-step graphs, ring64 and
+ring128 encodings, and the Pallas kernel ladder on / off / forced-
+fallback replay — any drift between the analyzer's stream model and
+the runtime shows up here as a count or trace mismatch.
+
+The cheap representative cases run in tier-1; the full matrix tail is
+``slow``.
+"""
+
+import numpy as np
+import pytest
+
+import moose_tpu as pm
+from moose_tpu.compilation.analysis.keystream import (
+    host_draw_counts,
+    stacked_draw_trace,
+)
+from moose_tpu.edsl import tracer
+from moose_tpu.execution import drawledger
+from moose_tpu.native import ring128_kernels as rk
+from moose_tpu.predictors.trainers import LogregSGDTrainer, MLPSGDTrainer
+from moose_tpu.runtime import LocalMooseRuntime
+
+PARTIES = ["alice", "bob", "carole"]
+RING64 = pm.fixed(8, 17)
+RING128 = pm.fixed(24, 40)
+N_ROWS, N_FEATURES, HIDDEN = 4, 2, 2
+RNG = np.random.default_rng(20260806)
+
+
+@pytest.fixture(autouse=True)
+def _fixed_keys(monkeypatch):
+    """The oracle contract is stated under MOOSE_TPU_FIXED_KEYS: key
+    generation is deterministic, so static key indices line up with the
+    runtime's key labels run after run."""
+    monkeypatch.setenv("MOOSE_TPU_FIXED_KEYS", "keystream-oracle")
+    monkeypatch.setenv("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
+
+
+def _trainer(model, fx):
+    if model == "logreg":
+        return LogregSGDTrainer(n_features=N_FEATURES, fixedpoint_dtype=fx)
+    return MLPSGDTrainer(n_features=N_FEATURES, hidden=HIDDEN,
+                         fixedpoint_dtype=fx)
+
+
+def _predict_graph(model, fx):
+    """Standalone inference graph (the serving shape: plaintext in,
+    one replicated forward pass, reveal to the data owner)."""
+    alice = pm.host_placement("alice")
+    bob = pm.host_placement("bob")
+    carole = pm.host_placement("carole")
+    rep = pm.replicated_placement("rep", players=[alice, bob, carole])
+
+    if model == "logreg":
+
+        @pm.computation
+        def predict(x: pm.Argument(alice, dtype=pm.float64),
+                    w: pm.Argument(bob, dtype=pm.float64)):
+            with alice:
+                xf = pm.cast(x, dtype=fx)
+            with bob:
+                wf = pm.cast(w, dtype=fx)
+            with rep:
+                y = pm.sigmoid(pm.dot(xf, wf))
+            with alice:
+                return pm.cast(y, dtype=pm.float64)
+
+        specs = {"x": (N_ROWS, N_FEATURES), "w": (N_FEATURES, 1)}
+        args = {
+            "x": RNG.normal(size=(N_ROWS, N_FEATURES)) * 0.3,
+            "w": RNG.normal(size=(N_FEATURES, 1)) * 0.3,
+        }
+    else:
+
+        @pm.computation
+        def predict(x: pm.Argument(alice, dtype=pm.float64),
+                    w1: pm.Argument(bob, dtype=pm.float64),
+                    w2: pm.Argument(bob, dtype=pm.float64)):
+            with alice:
+                xf = pm.cast(x, dtype=fx)
+            with bob:
+                w1f = pm.cast(w1, dtype=fx)
+                w2f = pm.cast(w2, dtype=fx)
+            with rep:
+                h = pm.sigmoid(pm.dot(xf, w1f))
+                y = pm.sigmoid(pm.dot(h, w2f))
+            with alice:
+                return pm.cast(y, dtype=pm.float64)
+
+        specs = {
+            "x": (N_ROWS, N_FEATURES),
+            "w1": (N_FEATURES, HIDDEN),
+            "w2": (HIDDEN, 1),
+        }
+        args = {
+            "x": RNG.normal(size=(N_ROWS, N_FEATURES)) * 0.3,
+            "w1": RNG.normal(size=(N_FEATURES, HIDDEN)) * 0.3,
+            "w2": RNG.normal(size=(HIDDEN, 1)) * 0.3,
+        }
+    return tracer.trace(predict), specs, args
+
+
+def _step_graph(model, fx):
+    tr = _trainer(model, fx)
+    comp = tr.step_computation(N_ROWS)
+    specs, _ = tr.range_specs(N_ROWS)
+    args = {
+        "x": RNG.normal(size=(N_ROWS, N_FEATURES)) * 0.3,
+        "y": RNG.uniform(size=(N_ROWS, 1)),
+    }
+    for name, shape in tr.state_shapes.items():
+        args[name] = RNG.normal(size=shape) * 0.3
+    return comp, dict(specs), args
+
+
+def _graph(model, graph, fx):
+    return (_step_graph if graph == "step" else _predict_graph)(model, fx)
+
+
+class _KernelMode:
+    """Pallas kernel ladder control for the duration of one oracle run:
+    forced on, forced off, or forced on with the horner kernel dying —
+    the error-fallback path that must REPLAY the identical draws
+    through the unfused ladder."""
+
+    def __init__(self, mode, monkeypatch):
+        self.mode = mode
+        self.monkeypatch = monkeypatch
+
+    def __enter__(self):
+        rk.reset_state()
+        if self.mode == "replay":
+            rk.set_enabled(True)
+
+            def boom(*a, **k):
+                raise RuntimeError("synthetic kernel failure")
+
+            self.monkeypatch.setattr(rk, "horner", boom)
+        else:
+            rk.set_enabled(self.mode == "on")
+        return self
+
+    def __exit__(self, *exc):
+        rk.set_enabled(None)
+        rk.reset_state()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# per-host oracle: static per-(party, key) counts == ledger counts
+# ---------------------------------------------------------------------------
+
+PER_HOST_CASES = [
+    pytest.param("logreg", "step", RING64, id="logreg-step-ring64"),
+    pytest.param("logreg", "predict", RING128,
+                 id="logreg-predict-ring128"),
+    pytest.param("logreg", "predict", RING64,
+                 marks=pytest.mark.slow, id="logreg-predict-ring64"),
+    pytest.param("logreg", "step", RING128,
+                 marks=pytest.mark.slow, id="logreg-step-ring128"),
+    pytest.param("mlp", "step", RING64,
+                 marks=pytest.mark.slow, id="mlp-step-ring64"),
+    pytest.param("mlp", "step", RING128,
+                 marks=pytest.mark.slow, id="mlp-step-ring128"),
+    pytest.param("mlp", "predict", RING64,
+                 marks=pytest.mark.slow, id="mlp-predict-ring64"),
+    pytest.param("mlp", "predict", RING128,
+                 marks=pytest.mark.slow, id="mlp-predict-ring128"),
+]
+
+
+@pytest.mark.parametrize("model,graph,fx", PER_HOST_CASES)
+def test_per_host_draw_counts_match_ledger(model, graph, fx):
+    comp, specs, args = _graph(model, graph, fx)
+    static = host_draw_counts(comp, arg_specs=specs)
+    assert static, "static report found no draws — analyzer regression"
+    rt = LocalMooseRuntime(PARTIES, layout="per-host", use_jit=False)
+    with drawledger.recording() as led:
+        rt.evaluate_computation(comp, arguments=args)
+    dynamic = led.host_report()
+    assert static == dynamic, (
+        f"per-(party, key) draw mismatch; static-only: "
+        f"{sorted(set(static) - set(dynamic))}; dynamic-only: "
+        f"{sorted(set(dynamic) - set(static))}; differing: "
+        f"{sorted(k for k in set(static) & set(dynamic) if static[k] != dynamic[k])}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# stacked oracle: abstract draw trace == recorded draw trace, across
+# the kernel ladder
+# ---------------------------------------------------------------------------
+
+# kernels-off runs are cheap everywhere; forced-on and replay runs pay
+# a Pallas interpret-mode compile per kernel shape on CPU, so only the
+# two representative off-mode cases ride in tier-1
+_FAST_STACKED = {("logreg", "step", "ring64", "off"),
+                 ("logreg", "predict", "ring128", "off")}
+STACKED_CASES = [
+    pytest.param(
+        model, graph, fx, mode,
+        marks=() if (model, graph, name, mode) in _FAST_STACKED
+        else pytest.mark.slow,
+        id=f"{model}-{graph}-{name}-{mode}",
+    )
+    for model, graph in (("logreg", "step"), ("logreg", "predict"),
+                         ("mlp", "step"), ("mlp", "predict"))
+    for fx, name in ((RING64, "ring64"), (RING128, "ring128"))
+    for mode in ("on", "off", "replay")
+]
+
+
+@pytest.mark.parametrize("model,graph,fx,mode", STACKED_CASES)
+def test_stacked_draw_trace_matches_run(model, graph, fx, mode,
+                                        monkeypatch):
+    comp, specs, args = _graph(model, graph, fx)
+    # the abstract trace fixes kernels off internally; compute it
+    # before arming the mode under test
+    static = stacked_draw_trace(comp, specs)
+    assert static, "static trace is empty — analyzer regression"
+    with _KernelMode(mode, monkeypatch):
+        rt = LocalMooseRuntime(PARTIES, layout="stacked", use_jit=False)
+        with drawledger.recording() as led:
+            rt.evaluate_computation(comp, arguments=args)
+    dynamic = led.stacked_trace()
+    assert static == dynamic, (
+        f"draw trace diverged at index "
+        f"{next((i for i, (s, d) in enumerate(zip(static, dynamic)) if s != d), min(len(static), len(dynamic)))}"
+        f" (static {len(static)} events, dynamic {len(dynamic)})"
+    )

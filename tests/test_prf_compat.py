@@ -15,6 +15,9 @@ assumed) could not be verified offline; the u64/u128 uniform paths and
 the seed derivation follow the published construction exactly.
 """
 
+import json
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -22,6 +25,14 @@ from moose_tpu.crypto.aes_prng import AesCtrRng, derive_seed
 from moose_tpu.crypto.blake3 import blake3, derive_key, keyed_hash
 from moose_tpu.dialects import ring
 from moose_tpu.dialects.aes import aes128_encrypt_block_np
+
+# the executable PRF specification: composed-construction vectors
+# (stream bytes per (seed, offset), block boundaries, draw orders, bit
+# granularity, seed derivation) recorded next to the implementation
+GOLDEN = json.loads(
+    (pathlib.Path(__file__).resolve().parents[1]
+     / "moose_tpu" / "crypto" / "prf_golden.json").read_text()
+)
 
 
 def test_blake3_official_empty_vector():
@@ -69,7 +80,7 @@ def test_reference_draw_orders():
 
 
 def test_derive_seed_golden():
-    """Golden value of the reference construction
+    """Golden values of the reference construction
     blake3.keyed_hash(blake3.derive_key("Derive Seed", key),
     sid(16) || sync(16))[:16] — pins this implementation across
     refactors; a pymoose cross-check would compare exactly this."""
@@ -79,18 +90,94 @@ def test_derive_seed_golden():
     assert seed == derive_seed(key, "sess", bytes(16))  # deterministic
     assert seed != derive_seed(key, "sess2", bytes(16))
     assert seed != derive_seed(key, "sess", bytes([1]) + bytes(15))
-    assert seed.hex() == derive_seed(key, "sess", bytes(16)).hex()
-    golden = seed.hex()
-    # recorded golden (computed by this implementation; stability gate)
-    import json
-    import pathlib
+    for vec in GOLDEN["derive_seed"]:
+        got = derive_seed(
+            bytes.fromhex(vec["key"]), vec["session_id"],
+            bytes.fromhex(vec["sync_key"]),
+        )
+        assert got.hex() == vec["seed"], vec
 
-    record = pathlib.Path(__file__).with_name("prf_golden.json")
-    if record.exists():
-        stored = json.loads(record.read_text())
-        assert stored["derive_seed"] == golden
-    else:  # first run records the vector
-        record.write_text(json.dumps({"derive_seed": golden}))
+
+def test_keystream_bytes_per_seed_and_offset():
+    """Exact stream bytes at every recorded (seed, offset) — the
+    stream is a pure function of (key, counter) with byte-granular
+    positions, so a read after skipping ``offset`` bytes must equal
+    the recorded slice regardless of how earlier reads were batched."""
+    for vec in GOLDEN["keystream"]:
+        rng = AesCtrRng(bytes.fromhex(vec["seed"]))
+        if vec["offset"]:
+            rng.next_bytes(vec["offset"])
+        got = rng.next_bytes(len(vec["bytes"]) // 2)
+        assert got.hex() == vec["bytes"], vec
+        # split reads concatenate to the same stream (no per-read
+        # block realignment)
+        rng2 = AesCtrRng(bytes.fromhex(vec["seed"]))
+        for _ in range(vec["offset"]):
+            rng2.next_bytes(1)
+        assert rng2.next_bytes(len(vec["bytes"]) // 2).hex() == vec["bytes"]
+
+
+def test_keystream_block_boundary():
+    """A read straddling the 16-byte block boundary is the suffix of
+    block(counter=0) followed by the prefix of block(counter=1) — the
+    counter increments little-endian per block with no byte skipped or
+    repeated."""
+    vec = GOLDEN["block_boundary"]
+    seed = bytes.fromhex(vec["seed"])
+    b0, b1 = bytes.fromhex(vec["block0"]), bytes.fromhex(vec["block1"])
+    assert b0 == aes128_encrypt_block_np(seed, (0).to_bytes(16, "little"))
+    assert b1 == aes128_encrypt_block_np(seed, (1).to_bytes(16, "little"))
+    off = vec["straddle_offset"]
+    straddle = bytes.fromhex(vec["straddle_bytes"])
+    assert straddle == (b0 + b1)[off:off + len(straddle)]
+    rng = AesCtrRng(seed)
+    rng.next_bytes(off)
+    assert rng.next_bytes(len(straddle)) == straddle
+
+
+def test_draw_order_goldens():
+    """The composed element orders: u64s are consecutive LE words,
+    u128s draw the high limb first, bit draws burn one keystream byte
+    per bit (the aes_prng crate's get_bit granularity)."""
+    for vec in GOLDEN["u64_draws"]:
+        got = AesCtrRng(bytes.fromhex(vec["seed"])).uniform_u64(
+            vec["count"]
+        )
+        assert [f"{v:016x}" for v in got] == vec["values"]
+    for vec in GOLDEN["u128_draws"]:
+        lo, hi = AesCtrRng(bytes.fromhex(vec["seed"])).uniform_u128(
+            vec["count"]
+        )
+        assert [f"{v:016x}" for v in lo] == vec["lo"]
+        assert [f"{v:016x}" for v in hi] == vec["hi"]
+    for vec in GOLDEN["bit_draws"]:
+        rng = AesCtrRng(bytes.fromhex(vec["seed"]))
+        assert list(map(int, rng.bits(vec["count"]))) == vec["bits"]
+        # one byte per bit: the stream position after n bit draws is
+        # exactly n bytes in
+        fresh = AesCtrRng(bytes.fromhex(vec["seed"]))
+        fresh.next_bytes(vec["consumed_bytes"])
+        assert rng.next_bytes(8) == fresh.next_bytes(8)
+
+
+def test_bit_domain_tagging():
+    """Bit draws flip the top bit of the last u32 seed word before
+    touching the cipher (``ring._bit_domain_seed``) — the domain
+    separation MSA802 audits: an untagged bit draw would share its
+    counter stream with ring draws from the same seed."""
+    vec = GOLDEN["bit_domain_tag"]
+    words = np.asarray(vec["seed_words"], dtype=np.uint32)
+    tagged = np.asarray(ring._bit_domain_seed(words))
+    assert tagged.tolist() == vec["tagged_words"]
+    assert (
+        np.bitwise_xor(words, np.asarray(vec["xor_mask"], np.uint32))
+        .tolist() == vec["tagged_words"]
+    )
+    # tagged and untagged streams are distinct from the first byte
+    seed = words.tobytes()
+    assert AesCtrRng(seed).next_bytes(16) != AesCtrRng(
+        tagged.astype(np.uint32).tobytes()
+    ).next_bytes(16)
 
 
 def test_secure_dot_under_aes_ctr_prf():

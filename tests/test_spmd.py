@@ -163,6 +163,50 @@ def test_logreg_step_sharded_party_mesh():
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
+def test_sharded_dot_mixed_consumer_repro(monkeypatch):
+    """The CPU SPMD-partitioner miscompile that motivates
+    ``_pin_contract_rhs``: a secure dot whose lhs shares are data-sharded
+    while the rhs share slices stay unconstrained, with the rhs consumed
+    by both the batched contraction and the pair-sum, returns garbage on
+    jax 0.4.37 with 12 virtual CPU devices unless the rhs is pinned
+    replicated.  The pinned path (the default on CPU) must stay exact;
+    the unpinned run documents the corruption when the backend still
+    exhibits it (constants alone do NOT trigger it — the PRF-drawn share
+    banks are part of the repro, so this drives the real protocol)."""
+    if len(jax.devices()) < 6:
+        pytest.skip("needs 6 virtual devices")
+    mesh = spmd.make_mesh(6)
+    rng = np.random.default_rng(2)
+    xv = rng.normal(size=(8, 3)) * 0.5
+    wv = rng.normal(size=(3, 1)) * 0.1
+
+    def run(pin_mode):
+        monkeypatch.setenv("MOOSE_TPU_SPMD_PIN", pin_mode)
+
+        def f(mk, x_f, w_f):
+            s = spmd.SpmdSession(mk)
+            xf = spmd.fx_encode_share(s, x_f, I, F, W)
+            wf = spmd.fx_encode_share(s, w_f, I, F, W)
+            xf = spmd.SpmdFixed(spmd.constrain(xf.tensor, mesh, 0), I, F)
+            return spmd.fx_reveal_decode(spmd.fx_dot(s, xf, wf))
+
+        with mesh:
+            return np.asarray(jax.jit(f)(MK, xv, wv))
+
+    want = xv @ wv
+    np.testing.assert_allclose(run("always"), want, atol=1e-5)
+    unpinned_err = float(np.max(np.abs(run("never") - want)))
+    # on the affected backend the unpinned error is astronomically large
+    # (~1e13 — uniform ring garbage, not rounding); a future XLA may fix
+    # the partitioner, in which case both paths are exact and the pinned
+    # assertion above remains the regression guard
+    if unpinned_err > 1e-3:
+        assert unpinned_err > 1e6, (
+            "unpinned path is inexact but not catastrophically so: "
+            f"{unpinned_err} — a new, different miscompile?"
+        )
+
+
 # ---------------------------------------------------------------------------
 # Stacked nonlinear protocol library (parallel/spmd_math.py)
 # ---------------------------------------------------------------------------
