@@ -1123,6 +1123,164 @@ def bench_training(features=8, rows=32, epochs=3):
     return record
 
 
+def bench_controlplane(features=8, rows=16, cycles=2):
+    """Continuous-training-loop bench (ISSUE 18, BENCH_r10+): the full
+    control-plane cycle — a resumable 3-party TrainingSession produces
+    a generation, the ControlPlane stages it onto 2 replica
+    InferenceServers behind real blitzen HTTP fronts and the donner
+    routing core, canaries it under live traffic, and promotes.
+
+    Records ``controlplane_promote_s`` (the warm base-flip: behind-the-
+    curtain re-warm + atomic queue swap + staging retire),
+    ``controlplane_rollback_s`` (the flip back past a detected SLO
+    breach — measured by running one deliberately-strict canary), and
+    ``loop_generations_per_hour`` (train -> stage -> canary -> promote
+    cycles, end to end)."""
+    import json as json_mod
+    import shutil
+    import tempfile
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from moose_tpu.bin.blitzen import ReplicaLifecycle, _make_handler
+    from moose_tpu.bin.donner import FleetConfig, Router
+    from moose_tpu.predictors.trainers import LogregSGDTrainer
+    from moose_tpu.runtime import LocalMooseRuntime
+    from moose_tpu.serving import (
+        CanaryConfig,
+        ControlPlane,
+        InferenceServer,
+        LocalFleetClient,
+        ServingConfig,
+        SessionGenerationProducer,
+    )
+    from moose_tpu.storage import FilesystemStorage
+    from moose_tpu.training import (
+        CheckpointStore,
+        TrainingConfig,
+        TrainingSession,
+    )
+    from moose_tpu.training.export import logreg_onnx_bytes
+    from moose_tpu.training.session import LocalTrainingCluster
+
+    parties = ["alice", "bob", "carole"]
+    rng = np.random.default_rng(18)
+    x = rng.normal(size=(rows, features)) * 0.5
+    y = (rng.uniform(size=(rows, 1)) > 0.5).astype(np.float64)
+    record = {}
+    tmp = tempfile.mkdtemp(prefix="bench_controlplane_")
+    servers, httpds = [], []
+    stop = threading.Event()
+    try:
+        from moose_tpu import predictors
+
+        base_model = predictors.from_onnx(
+            logreg_onnx_bytes(rng.normal(size=(features, 1)) * 0.5)
+        )
+        config = ServingConfig.from_env(
+            max_batch=4, max_wait_ms=2.0, queue_bound=256
+        )
+        for ri in range(2):
+            server = InferenceServer(config=config)
+            server.register_model(
+                "m", base_model, row_shape=(features,)
+            )
+            servers.append(server)
+            httpd = ThreadingHTTPServer(
+                ("127.0.0.1", 0),
+                _make_handler(
+                    server, ReplicaLifecycle(name=f"cp-replica-{ri}")
+                ),
+            )
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+            httpds.append(httpd)
+        router = Router(
+            [f"http://127.0.0.1:{h.server_port}" for h in httpds],
+            config=FleetConfig(
+                probe_interval_ms=100.0, max_attempts=6,
+                backoff_ms=5.0,
+            ),
+        )
+        router.start()
+        for replica in router.replicas:
+            router.probe_once(replica)
+
+        # live traffic for the canary windows: one tenant, fraction 1.0
+        # below, so every request lands in the canary generation's
+        # sliding window and verdicts collect min_requests fast
+        body = json_mod.dumps(
+            {"x": rng.normal(size=(1, features)).tolist()}
+        ).encode()
+
+        def pump():
+            while not stop.is_set():
+                router.forward(
+                    "/v1/models/m:predict", body,
+                    {"X-Moose-Tenant": "bench"},
+                )
+                stop.wait(0.05)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        stores = {
+            p: CheckpointStore(
+                FilesystemStorage(os.path.join(tmp, p)), party=p
+            )
+            for p in parties
+        }
+        runtime = LocalMooseRuntime(
+            identities=parties, storage_mapping=stores, use_jit=False
+        )
+        session = TrainingSession(
+            LogregSGDTrainer(n_features=features, learning_rate=0.1),
+            LocalTrainingCluster(runtime, parties),
+            TrainingConfig(epochs=1, session_timeout_s=60),
+        )
+        producer = SessionGenerationProducer(
+            session, x, y, epochs_per_generation=1
+        )
+        client = LocalFleetClient(router, servers)
+        plane = ControlPlane(client, "m", CanaryConfig(
+            fraction=1.0, watch_s=0.5, min_requests=3,
+            p99_slo_s=60.0, error_rate_slo=0.5, poll_s=0.05,
+            timeout_s=120.0, cost_drift_max=10**9,
+        ))
+        t0 = time.perf_counter()
+        reports = plane.run_loop(producer, generations=cycles)
+        loop_s = time.perf_counter() - t0
+        assert all(r["promoted"] for r in reports), reports
+        record["controlplane_promote_s"] = float(
+            np.median([r["promote_s"] for r in reports])
+        )
+        record["loop_generations_per_hour"] = cycles / (loop_s / 3600)
+        record["controlplane_cycles"] = cycles
+
+        # rollback flip: one deliberately-strict canary (any observed
+        # latency breaches), so the measured number is the flip itself,
+        # not the breach detector's patience
+        strict = ControlPlane(client, "m", CanaryConfig(
+            fraction=1.0, watch_s=0.5, min_requests=3,
+            p99_slo_s=1e-9, error_rate_slo=0.5, poll_s=0.05,
+            timeout_s=120.0, cost_drift_max=10**9,
+        ))
+        report = strict.run_loop(producer, generations=1)[0]
+        assert not report["promoted"] and report["reason"] == "latency", (
+            report
+        )
+        record["controlplane_rollback_s"] = report["rollback_s"]
+        router.stop()
+    finally:
+        stop.set()
+        for httpd in httpds:
+            httpd.shutdown()
+        for server in servers:
+            server.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return record
+
+
 def main():
     rng = np.random.default_rng(42)
     a = rng.normal(size=(N, N))
@@ -1372,6 +1530,16 @@ def main():
             emit()
     except Exception as e:
         print(f"# training bench failed: {e}")
+
+    # continuous-training control plane (ISSUE 18, BENCH_r10+): the
+    # full train -> stage -> canary -> promote cycle against a live
+    # 2-replica fleet, plus the rollback flip past a detected breach
+    try:
+        if _within_budget():
+            record.update(bench_controlplane())
+            emit()
+    except Exception as e:
+        print(f"# controlplane bench failed: {e}")
 
     # distributed worker fast path (ISSUE 5): 3-worker logreg batch-128
     # over local TCP — compiled per-role plans vs the legacy eager

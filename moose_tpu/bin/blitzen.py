@@ -10,6 +10,10 @@ the telemetry exporter: nothing to install in the serving image).
   GET  /healthz                    liveness: 200 while the process runs
   GET  /readyz                     readiness: 200 only when serving;
                                    503 {"status": "warming"|"draining"}
+  POST /admin/models/<name>:load   register/hot-swap a generation from
+                                   ONNX bytes (only with --admin)
+  POST /admin/models/<name>:unload retire a generation (--admin)
+  POST /admin/chaos                latency fault injection (--admin)
 
 Every model file is an ONNX graph imported through ``from_onnx`` (the
 same path the examples use); registration traces, compiles each batch
@@ -40,6 +44,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 from pathlib import Path
 
 
@@ -149,12 +154,21 @@ def build_server(model_paths: dict, row_features: dict, args):
             server.snapshot_report = server.load_snapshot(
                 snapshot_dir, source_digests=source_digests
             )
+            executed = sum(
+                1
+                for verdicts in (
+                    server.snapshot_report.get("aot") or {}
+                ).values()
+                for verdict in verdicts.values()
+                if verdict == "executed"
+            )
             print(
                 "blitzen: restored warm state from "
                 f"{server.snapshot_report['snapshot']} in "
                 f"{server.snapshot_report['rewarm_s']:.2f}s "
                 f"({server.snapshot_report['probe_checked']} probe "
-                "digest(s) verified)",
+                f"digest(s) verified, {executed} AOT bucket(s) "
+                "executed)",
                 flush=True,
             )
             _record_rewarm(server.snapshot_report["rewarm_s"])
@@ -249,7 +263,26 @@ def _record_rewarm(seconds: float) -> None:
     ).set(seconds)
 
 
-def _make_handler(server, lifecycle=None):
+def _parse_chaos(spec: str) -> dict:
+    """``match:<substr>,delay_ms:<n>`` -> a mutable chaos holder: every
+    predict whose serving name contains ``match`` sleeps ``delay_ms``
+    first.  The loop smoke poisons a canary generation exactly this way
+    (MOOSE_TPU_CHAOS_SERVE, or POST /admin/chaos at runtime)."""
+    chaos = {"match": "", "delay_ms": 0.0}
+    for part in (spec or "").split(","):
+        key, _, value = part.partition(":")
+        key = key.strip()
+        if key == "match":
+            chaos["match"] = value.strip()
+        elif key == "delay_ms":
+            try:
+                chaos["delay_ms"] = float(value)
+            except ValueError:
+                pass
+    return chaos
+
+
+def _make_handler(server, lifecycle=None, admin: bool = False):
     from concurrent.futures import TimeoutError as FutureTimeoutError
     from http.server import BaseHTTPRequestHandler
 
@@ -261,6 +294,7 @@ def _make_handler(server, lifecycle=None):
         is_retryable,
     )
 
+    chaos = _parse_chaos(os.environ.get("MOOSE_TPU_CHAOS_SERVE", ""))
     lifecycle = lifecycle or ReplicaLifecycle()
     if lifecycle.state == "warming" and server.registry.names():
         # built via the in-process API (tests) where warmup already
@@ -359,6 +393,9 @@ def _make_handler(server, lifecycle=None):
                 self._reply(404, {"error": "NotFound", "path": self.path})
 
         def do_POST(self):
+            if admin and self.path.startswith("/admin/"):
+                self._handle_admin()
+                return
             prefix, suffix = "/v1/models/", ":predict"
             if not (
                 self.path.startswith(prefix)
@@ -389,11 +426,49 @@ def _make_handler(server, lifecycle=None):
                         f"replica is {lifecycle.state}; retry on "
                         "another replica"
                     )
-                y = server.predict(
-                    name,
-                    request["x"],
-                    deadline_ms=deadline_ms,
-                )
+                if name not in server.registry:
+                    # 404 + the typed class donner keys its
+                    # generation-miss retry on: a replica restarted
+                    # from its durable snapshot no longer holds
+                    # ephemeral generations — a peer might
+                    self._reply(404, {
+                        "error": "ModelNotFoundError",
+                        "message": (
+                            f"unknown model {name!r}; registered: "
+                            f"{server.registry.names()}"
+                        ),
+                        "retryable": False,
+                    })
+                    return
+                if (
+                    chaos["match"]
+                    and chaos["delay_ms"] > 0
+                    and chaos["match"] in name
+                ):
+                    time.sleep(chaos["delay_ms"] / 1e3)
+                try:
+                    y = server.predict(
+                        name,
+                        request["x"],
+                        deadline_ms=deadline_ms,
+                    )
+                except Exception:
+                    if name not in server.registry:
+                        # the generation was retired between admission
+                        # and eval (control-plane rollback racing an
+                        # in-flight request): answer the typed
+                        # generation-miss so donner retries a peer or
+                        # falls back to last-good instead of surfacing
+                        self._reply(404, {
+                            "error": "ModelNotFoundError",
+                            "message": (
+                                f"model {name!r} unloaded while the "
+                                "request was in flight"
+                            ),
+                            "retryable": False,
+                        })
+                        return
+                    raise
                 self._reply(200, {"y": y.tolist()})
             except ReplicaDrainingError as e:
                 self._reply_error(503, e, headers={"Retry-After": "1"})
@@ -417,6 +492,98 @@ def _make_handler(server, lifecycle=None):
                 # handler abort and drop the keep-alive socket) keeps
                 # the always-answer contract for unforeseen classes too
                 self._reply_error(500, e)
+
+        # -- control-plane admin surface (only with --admin) -----------
+
+        def _handle_admin(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                request = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._reply_error(400, e)
+                return
+            if self.path == "/admin/chaos":
+                chaos["match"] = str(request.get("match") or "")
+                chaos["delay_ms"] = float(request.get("delay_ms") or 0.0)
+                self._reply(200, {"chaos": dict(chaos)})
+                return
+            prefix = "/admin/models/"
+            if not self.path.startswith(prefix) or ":" not in self.path:
+                self._reply(404, {"error": "NotFound", "path": self.path})
+                return
+            name, _, action = self.path[len(prefix):].partition(":")
+            try:
+                if action == "load":
+                    self._admin_load(name, request)
+                elif action == "unload":
+                    if name not in server.registry:
+                        self._reply(404, {
+                            "error": "ModelNotFoundError",
+                            "message": f"unknown model {name!r}",
+                            "retryable": False,
+                        })
+                        return
+                    server.unregister_model(name)
+                    getattr(
+                        server, "generation_digests", {}
+                    ).pop(name, None)
+                    self._reply(200, {"status": "unloaded", "model": name})
+                else:
+                    self._reply(
+                        404, {"error": "NotFound", "path": self.path}
+                    )
+            except (CompilationError, ConfigurationError, KeyError,
+                    ValueError) as e:
+                self._reply_error(400, e)
+            except Exception as e:  # noqa: BLE001 — always answer
+                self._reply_error(500, e)
+
+        def _admin_load(self, name, request):
+            """Register (or hot-swap) a model generation from ONNX
+            bytes.  Idempotent on the source digest: re-sending the
+            same generation (a control-plane retry after a replica
+            restart) answers ``already`` without re-warming."""
+            import base64
+
+            from moose_tpu import predictors
+
+            if request.get("onnx_b64"):
+                raw = base64.b64decode(request["onnx_b64"])
+            else:
+                raw = Path(request["path"]).read_bytes()
+            n_features = int(request["features"])
+            buckets = tuple(int(b) for b in request.get("buckets") or ())
+            digest = hashlib.blake2b(
+                raw + repr(
+                    (n_features, server.config.max_batch)
+                ).encode(),
+                digest_size=16,
+            ).hexdigest()
+            digests = getattr(server, "generation_digests", None)
+            if digests is None:
+                digests = server.generation_digests = {}
+            if name in server.registry:
+                if digests.get(name) == digest:
+                    self._reply(200, {
+                        "status": "already", "model": name,
+                        "digest": digest,
+                    })
+                    return
+                server.replace_model(
+                    name, predictors.from_onnx(raw),
+                    row_shape=(n_features,), buckets=buckets,
+                )
+                status = "replaced"
+            else:
+                server.register_model(
+                    name, predictors.from_onnx(raw),
+                    row_shape=(n_features,), buckets=buckets,
+                )
+                status = "registered"
+            digests[name] = digest
+            self._reply(200, {
+                "status": status, "model": name, "digest": digest,
+            })
 
     return Handler
 
@@ -466,6 +633,12 @@ def main(argv=None):
         help='evaluate one {"model": ..., "x": [[...]]} request and '
         "print the result instead of serving (smoke/docs)",
     )
+    parser.add_argument(
+        "--admin", action="store_true",
+        default=os.environ.get("MOOSE_TPU_SERVE_ADMIN", "0") == "1",
+        help="enable /admin/* (generation load/unload + chaos knobs; "
+        "bind only on a trusted interface — MOOSE_TPU_SERVE_ADMIN=1)",
+    )
     args = parser.parse_args(argv)
 
     model_paths = parse_models(args.models)
@@ -501,7 +674,8 @@ def main(argv=None):
 
     lifecycle = ReplicaLifecycle()
     httpd = ThreadingHTTPServer(
-        (args.host, args.port), _make_handler(server, lifecycle)
+        (args.host, args.port),
+        _make_handler(server, lifecycle, admin=args.admin),
     )
     # the registry is warm (restored or freshly registered) and the
     # socket is bound: this replica may receive traffic
@@ -526,11 +700,15 @@ def main(argv=None):
         ).set(time.perf_counter() - t0)
         if snapshot_dir:
             try:
+                # only the durable (CLI-registered) models: ephemeral
+                # control-plane generations must not enter the snapshot
+                # or the restore side's source-digest set-equality
+                # check would reject it on the next cold start
+                durable = getattr(server, "source_digests", None)
                 server.save_snapshot(
                     snapshot_dir,
-                    source_digests=getattr(
-                        server, "source_digests", None
-                    ),
+                    source_digests=durable,
+                    only=set(durable) if durable else None,
                 )
             except Exception as e:  # noqa: BLE001 — a failed snapshot
                 # must not turn a clean drain into a crash loop; the

@@ -10,7 +10,10 @@ nothing to install in the serving image).
   GET  /metrics                    router metrics, Prometheus text
   GET  /healthz                    router liveness
   GET  /readyz                     200 iff >= 1 replica is ready
-  GET  /fleet                      per-replica routing state (JSON)
+  GET  /fleet                      per-replica + per-generation routing
+                                   state (JSON)
+  POST /admin/routes               set/clear a model's generation split
+                                   (only with --admin)
 
 Routing policy (see DEVELOP.md "Fleet serving"):
 
@@ -31,7 +34,23 @@ Routing policy (see DEVELOP.md "Fleet serving"):
   the ``X-Moose-Tenant`` header names the bucket (``default``
   otherwise); an empty bucket answers a typed retryable 429 without
   consuming replica capacity — this layers ON TOP of blitzen's own
-  typed 429/504 backpressure, it does not replace it.
+  typed 429/504 backpressure, it does not replace it;
+- **per-model weighted generation routing** (the control plane's
+  canary lever, DEVELOP.md "Continuous training loop"):
+  ``set_route(model, {label: weight}, canary=...)`` splits a model's
+  traffic across generation labels — label ``base`` is the bare model
+  name, any other label routes to the serving name
+  ``<model>@<label>``.  Assignment is a deterministic hash bucket of
+  ``(model, tenant)``, so one tenant's requests stay on ONE generation
+  for a given split, and ramping the canary weight only ever migrates
+  tenants base -> canary (never back and forth).  A generation-routed
+  request answered 404 ``ModelNotFoundError`` (a replica restarted
+  from snapshot without the ephemeral canary) retries on another
+  replica and, exhausted, falls back to the last-good label — a
+  mid-canary replica kill degrades a tenant to the old generation
+  instead of erroring.  Per-(model, generation) sliding windows of
+  latency/error samples feed the control plane's SLO watch via
+  ``/fleet``.
 
 A request is "dropped" only if every routing attempt is exhausted with
 no ready replica to try — the fleet smoke asserts this never happens
@@ -41,6 +60,7 @@ across a replica kill + rolling restart.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import random
@@ -48,6 +68,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import ConfigurationError
@@ -87,12 +108,16 @@ class FleetConfig:
             "tenant_burst": _env_number(
                 "MOOSE_TPU_FLEET_TENANT_BURST", 0.0, float
             ),
+            "window_s": _env_number(
+                "MOOSE_TPU_FLEET_WINDOW_S", 60.0, float
+            ),
         }
         env.update({k: v for k, v in overrides.items() if v is not None})
         unknown = set(env) - {
             "probe_interval_ms", "eject_after", "readmit_after",
             "max_attempts", "backoff_ms", "backoff_cap_ms",
             "attempt_timeout_s", "tenant_rate", "tenant_burst",
+            "window_s",
         }
         if unknown:
             raise ConfigurationError(f"unknown fleet knobs: {unknown}")
@@ -132,6 +157,89 @@ class TokenBucket:
                 self._tokens -= n
                 return True
             return False
+
+
+class _GenWindow:
+    """Sliding per-(model, generation) SLO window: (monotonic stamp,
+    end-to-end latency, error?) samples trimmed to the last
+    ``window_s`` seconds.  ``error`` counts what a client would see as
+    a failed or throttled request (5xx or 429) — the control plane's
+    typed-error-rate SLO reads ``error_rate`` off ``stats()``."""
+
+    def __init__(self, window_s: float):
+        self.window_s = float(window_s)
+        self._samples = deque()
+        self._lock = threading.Lock()
+
+    def add(self, latency_s: float, error: bool) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._samples.append((now, float(latency_s), bool(error)))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._trim(time.monotonic())
+            samples = list(self._samples)
+        count = len(samples)
+        if not count:
+            return {
+                "count": 0, "errors": 0, "error_rate": 0.0,
+                "p50_s": 0.0, "p99_s": 0.0,
+            }
+        errors = sum(1 for _, _, err in samples if err)
+        latencies = sorted(latency for _, latency, _ in samples)
+
+        def pct(p: float) -> float:
+            return latencies[min(count - 1, int(p * count))]
+
+        return {
+            "count": count,
+            "errors": errors,
+            "error_rate": errors / count,
+            "p50_s": pct(0.50),
+            "p99_s": pct(0.99),
+        }
+
+
+def _parse_model_path(path: str) -> Optional[Tuple[str, str]]:
+    """``/v1/models/<name>:<action>`` -> (name, action), else None."""
+    prefix = "/v1/models/"
+    if not path.startswith(prefix) or ":" not in path:
+        return None
+    name, _, action = path[len(prefix):].partition(":")
+    return (name, action) if name and action else None
+
+
+def _serving_path(model: str, label: str, action: str) -> str:
+    name = model if label == "base" else f"{model}@{label}"
+    return f"/v1/models/{name}:{action}"
+
+
+def _assign_generation(model: str, tenant: str,
+                       weights: Dict[str, float]) -> str:
+    """Deterministic hash-bucket generation assignment: the same
+    (model, tenant) always lands at the same point r in [0, 1), and the
+    cumulative walk is over SORTED labels — so as a canary's weight
+    ramps, tenants only ever cross the boundary in one direction (a
+    tenant never flaps between generations mid-ramp)."""
+    digest = hashlib.blake2b(
+        f"{model}|{tenant}".encode(), digest_size=8
+    ).digest()
+    r = int.from_bytes(digest, "big") / 2 ** 64
+    total = sum(weights.values())
+    acc = 0.0
+    labels = sorted(weights)
+    for label in labels:
+        acc += weights[label] / total
+        if r < acc:
+            return label
+    return labels[-1]
 
 
 class Replica:
@@ -185,6 +293,17 @@ class RouterMetrics:
             "requests rejected by per-tenant token-bucket admission",
             labels=("tenant",),
         )
+        self.generation_requests = metrics.counter(
+            "moose_tpu_donner_generation_requests_total",
+            "requests routed per model generation",
+            labels=("model", "generation"),
+        )
+        self.generation_fallbacks = metrics.counter(
+            "moose_tpu_donner_generation_fallbacks_total",
+            "generation-routed requests that fell back to the "
+            "last-good generation after a fleet-wide generation miss",
+            labels=("model",),
+        )
         self.ready_gauge = metrics.gauge(
             "moose_tpu_donner_ready_replicas",
             "replicas currently in rotation",
@@ -211,6 +330,12 @@ class Router:
         self._rr = 0
         self._lock = threading.Lock()
         self._buckets: Dict[str, TokenBucket] = {}
+        # per-model generation routing: model -> {"weights": {label:
+        # normalized weight}, "canary": label or None}; windows keyed
+        # (model, label) outlive route changes so post-flip stats stay
+        # scrapeable
+        self._routes: Dict[str, dict] = {}
+        self._windows: Dict[Tuple[str, str], _GenWindow] = {}
         self._stop = threading.Event()
         self._prober = None
 
@@ -327,12 +452,144 @@ class Router:
             self._rr += 1
             return pool[self._rr % len(pool)]
 
+    # -- generation routing --------------------------------------------------
+
+    def set_route(self, model: str, weights: Dict[str, float],
+                  canary: Optional[str] = None) -> Optional[dict]:
+        """Install a weighted generation split for ``model``.  Labels
+        are generation names; the reserved label ``base`` is the bare
+        model name, anything else routes to ``<model>@<label>``.
+        Weights are normalized; zero-weight labels are dropped.
+        ``canary`` marks which label the control plane is watching (it
+        surfaces in ``/fleet``, routing treats it like any other
+        label).  Atomic: in-flight requests see either the old or the
+        new split, never a mix.  Returns the previous route (or
+        None)."""
+        clean: Dict[str, float] = {}
+        for label, weight in (weights or {}).items():
+            weight = float(weight)
+            if weight < 0:
+                raise ConfigurationError(
+                    f"route weight for {label!r} must be >= 0"
+                )
+            if weight > 0:
+                clean[str(label)] = weight
+        total = sum(clean.values())
+        if total <= 0:
+            raise ConfigurationError(
+                f"route for {model!r} needs at least one positive "
+                f"weight, got {weights!r}"
+            )
+        clean = {label: w / total for label, w in clean.items()}
+        if canary is not None and canary not in clean:
+            raise ConfigurationError(
+                f"canary label {canary!r} not among weighted labels "
+                f"{sorted(clean)}"
+            )
+        with self._lock:
+            previous = self._routes.get(model)
+            self._routes[model] = {"weights": clean, "canary": canary}
+        return previous
+
+    def clear_route(self, model: str) -> Optional[dict]:
+        """Drop ``model``'s generation split: all traffic back on the
+        bare model name.  Atomic, like :meth:`set_route`."""
+        with self._lock:
+            return self._routes.pop(model, None)
+
+    def _resolve(self, path: str, headers: Dict[str, str]):
+        """(model, generation label, routed path) for one request —
+        (None, "base", path) when the path is not a model call or the
+        model has no route installed."""
+        parsed = _parse_model_path(path)
+        if parsed is None:
+            return None, "base", path
+        model, action = parsed
+        with self._lock:
+            route = self._routes.get(model)
+            weights = dict(route["weights"]) if route else None
+        if not weights:
+            return model, "base", path
+        tenant = "default"
+        for key, value in headers.items():
+            if key.lower() == "x-moose-tenant":
+                tenant = value
+                break
+        label = _assign_generation(model, tenant, weights)
+        return model, label, _serving_path(model, label, action)
+
+    def _last_good(self, model: str, failed: str) -> str:
+        """The fallback label when ``failed`` is missing fleet-wide:
+        ``base`` when it carries weight (or no route is left), else the
+        heaviest other label."""
+        with self._lock:
+            route = self._routes.get(model)
+            weights = dict(route["weights"]) if route else {}
+        others = {
+            label: w for label, w in weights.items() if label != failed
+        }
+        if not others or "base" in others:
+            return "base"
+        return max(sorted(others), key=others.get)
+
+    def _window(self, model: str, generation: str) -> _GenWindow:
+        key = (model, generation)
+        with self._lock:
+            window = self._windows.get(key)
+            if window is None:
+                window = self._windows[key] = _GenWindow(
+                    self.config.window_s
+                )
+            return window
+
     def forward(self, path: str, body: bytes,
                 headers: Dict[str, str]) -> Tuple[int, bytes, dict]:
-        """Route one request: returns (status, body, info).  Retryable
-        failures rotate to a different replica under capped
-        exponential backoff; after ``max_attempts`` the LAST typed
-        answer (or a 503 when no replica ever answered) surfaces."""
+        """Route one request through the generation split (if any) and
+        the replica retry loop: returns (status, body, info).  A
+        generation answered 404 ``ModelNotFoundError`` by every tried
+        replica falls back to the last-good label — the caller never
+        sees a canary-only outage."""
+        model, generation, routed = self._resolve(path, headers)
+        t0 = time.monotonic()
+        status, payload, info = self._forward_attempts(
+            routed, body, headers, generation_routed=generation != "base"
+        )
+        if (
+            generation != "base"
+            and status == 404
+            and _body_error_class(payload) == "ModelNotFoundError"
+        ):
+            self.metrics.generation_fallbacks.inc(model=model)
+            generation = self._last_good(model, generation)
+            parsed = _parse_model_path(path)
+            fallback = _serving_path(model, generation, parsed[1])
+            status, payload, info = self._forward_attempts(
+                fallback, body, headers,
+                generation_routed=generation != "base",
+            )
+            info["generation_fallback"] = True
+        if model is not None:
+            self._window(model, generation).add(
+                time.monotonic() - t0,
+                error=status >= 500 or status == 429,
+            )
+            self.metrics.generation_requests.inc(
+                model=model, generation=generation
+            )
+            info["generation"] = generation
+        return status, payload, info
+
+    def _forward_attempts(
+        self, path: str, body: bytes, headers: Dict[str, str],
+        generation_routed: bool = False,
+    ) -> Tuple[int, bytes, dict]:
+        """The replica retry loop: retryable failures rotate to a
+        different replica under capped exponential backoff; after
+        ``max_attempts`` the LAST typed answer (or a 503 when no
+        replica ever answered) surfaces.  When ``generation_routed``, a
+        404 ``ModelNotFoundError`` is treated as retryable too — only a
+        replica restarted without the ephemeral generation answers it,
+        and a peer that still holds the generation can serve."""
         config = self.config
         tried = set()
         last: Optional[Tuple[int, bytes]] = None
@@ -394,13 +651,25 @@ class Router:
                     ),
                 )
             elif status < 500 and status != 429:
-                # success or a non-retryable client-side answer: pass
-                # through untouched (bodies already carry typed errors)
-                self._count(status)
-                return status, payload, {
-                    "replica": replica.base_url,
-                    "attempts": attempts,
-                }
+                if (
+                    generation_routed
+                    and status == 404
+                    and _body_error_class(payload) == "ModelNotFoundError"
+                ):
+                    # generation miss: THIS replica lost the ephemeral
+                    # generation (restarted from its durable snapshot);
+                    # a peer may still hold it — rotate, don't surface
+                    last = (status, payload)
+                    self.metrics.retries.inc(reason="generation-miss")
+                else:
+                    # success or a non-retryable client-side answer:
+                    # pass through untouched (bodies already carry
+                    # typed errors)
+                    self._count(status)
+                    return status, payload, {
+                        "replica": replica.base_url,
+                        "attempts": attempts,
+                    }
             else:
                 last = (status, payload)
                 if not _body_retryable(payload):
@@ -459,9 +728,25 @@ class Router:
         self.metrics.requests.inc(outcome=bucket)
 
     def fleet_snapshot(self) -> dict:
+        with self._lock:
+            routes = {
+                model: {
+                    "weights": dict(route["weights"]),
+                    "canary": route["canary"],
+                    "window": {},
+                }
+                for model, route in self._routes.items()
+            }
+            windows = dict(self._windows)
+        for (model, generation), window in windows.items():
+            entry = routes.setdefault(
+                model, {"weights": {}, "canary": None, "window": {}}
+            )
+            entry["window"][generation] = window.stats()
         return {
             "replicas": [r.snapshot() for r in self.replicas],
             "ready": len(self.ready_replicas()),
+            "routes": routes,
         }
 
 
@@ -469,6 +754,14 @@ def _typed_body(cls: str, message: str, retryable: bool) -> bytes:
     return json.dumps({
         "error": cls, "message": message, "retryable": retryable,
     }).encode()
+
+
+def _body_error_class(payload: bytes) -> str:
+    """The typed ``error`` class of a wire body ("" when untyped)."""
+    try:
+        return str(json.loads(payload.decode()).get("error") or "")
+    except (ValueError, UnicodeDecodeError):
+        return ""
 
 
 def _body_retryable(payload: bytes) -> bool:
@@ -483,7 +776,7 @@ def _body_retryable(payload: bytes) -> bool:
         return True
 
 
-def _make_handler(router: Router):
+def _make_handler(router: Router, admin: bool = False):
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
@@ -535,6 +828,36 @@ def _make_handler(router: Router):
                 )
 
         def do_POST(self):
+            if admin and self.path == "/admin/routes":
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    spec = json.loads(raw.decode())
+                    model = spec["model"]
+                    if spec.get("clear"):
+                        router.clear_route(model)
+                    else:
+                        router.set_route(
+                            model, spec.get("weights") or {},
+                            canary=spec.get("canary"),
+                        )
+                except (KeyError, ValueError, TypeError,
+                        ConfigurationError) as e:
+                    self._reply(
+                        400,
+                        _typed_body(
+                            "ConfigurationError", str(e),
+                            retryable=False,
+                        ),
+                    )
+                    return
+                self._reply(
+                    200,
+                    json.dumps(
+                        router.fleet_snapshot()["routes"]
+                    ).encode(),
+                )
+                return
             if not self.path.startswith("/v1/models/"):
                 self._reply(
                     404,
@@ -603,6 +926,12 @@ def main(argv=None):
         "--tenant-burst", type=float, default=None,
         help="per-tenant burst capacity (MOOSE_TPU_FLEET_TENANT_BURST)",
     )
+    parser.add_argument(
+        "--admin", action="store_true",
+        default=os.environ.get("MOOSE_TPU_FLEET_ADMIN", "0") == "1",
+        help="enable POST /admin/routes (generation routing control; "
+        "bind only on a trusted interface — MOOSE_TPU_FLEET_ADMIN=1)",
+    )
     args = parser.parse_args(argv)
 
     config = FleetConfig(
@@ -619,7 +948,7 @@ def main(argv=None):
     from http.server import ThreadingHTTPServer
 
     httpd = ThreadingHTTPServer(
-        (args.host, args.port), _make_handler(router)
+        (args.host, args.port), _make_handler(router, admin=args.admin)
     )
     print(
         f"donner: routing over {len(router.replicas)} replica(s) on "

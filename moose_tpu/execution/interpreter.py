@@ -862,6 +862,36 @@ def _registry():
     return _plan_registry
 
 
+# AOT-artifact preloads, weak-keyed on the computation: serialized
+# ``jax.export`` programs a snapshot restore stashes here so the runner
+# restored at promoted whole-graph jit EXECUTES the deserialized XLA
+# program instead of re-jitting its own candidate (the serving
+# snapshot's skip-even-the-cached-compile path — the artifact is
+# matched to a binding by input avals at the first call).
+_aot_preloads = None  # WeakKeyDictionary, initialized lazily
+
+
+def _aot_stash():
+    global _aot_preloads
+    if _aot_preloads is None:
+        import weakref
+
+        _aot_preloads = weakref.WeakKeyDictionary()
+    return _aot_preloads
+
+
+def preload_aot_artifact(comp, plan_key: str, blob: bytes) -> None:
+    """Register one serialized ``jax.export`` artifact for ``comp``:
+    the next :class:`_SelfCheckRunner` constructed for ``plan_key`` at
+    restored promoted-jit mode deserializes it and runs the exported
+    program directly — jax only abstractly traces the candidate once
+    (``eval_shape``, to recover the output treedef) and never lowers or
+    compiles it, not even through the persistent compile cache."""
+    _aot_stash().setdefault(comp, {}).setdefault(
+        plan_key, []
+    ).append(bytes(blob))
+
+
 class _SelfCheckRunner(_SelfCheckBase):
     """THE validated-jit runner, shared by the logical and physical
     executors (VERDICT r4 #6: one self-check engine, not two).
@@ -917,6 +947,85 @@ class _SelfCheckRunner(_SelfCheckBase):
             checks,
             level=saved["level"] if saved else 0,
             mode=saved["mode"] if saved else None,
+        )
+        # snapshot restores stash serialized jax.export artifacts per
+        # (comp, plan_key); a runner restored at promoted jit adopts one
+        # lazily so the first call executes the exported program instead
+        # of lowering+compiling its own candidate
+        self._aot_state = None
+        if self.mode == "jit" and self._jit_fn is not None:
+            blobs = _aot_stash().get(comp, {}).get(self._plan_key)
+            if blobs:
+                self._adopt_preloaded_aot(list(blobs))
+
+    @property
+    def aot_state(self):
+        """None (no artifact preloaded), ``pending`` (artifact staged,
+        not yet bound to this binding's avals), ``adopted`` (the
+        exported program is what runs), or ``fallback`` (binding failed;
+        the ordinary jit candidate runs)."""
+        return self._aot_state
+
+    def _adopt_preloaded_aot(self, blobs):
+        """Wrap the promoted candidate so the first call binds a
+        preloaded ``jax.export`` artifact to this binding's input avals
+        and executes the deserialized program from then on.  The traced
+        candidate is only abstractly evaluated (``jax.eval_shape``, to
+        recover the output treedef the flat export lost) — never
+        lowered, never compiled, not even through the persistent compile
+        cache.  Binding is best-effort: any failure falls back to the
+        ordinary jit path."""
+        traced = self._jit_fn
+        bound = {}
+        self._aot_state = "pending"
+
+        def aot_run(*args):
+            fn = bound.get("fn")
+            if fn is None:
+                try:
+                    fn = self._bind_aot(traced, blobs, args)
+                    self._aot_state = "adopted"
+                except Exception as e:  # noqa: BLE001 — the artifact is
+                    # an optimization; never let it take down serving
+                    from ..logger import get_logger
+
+                    get_logger().warning(
+                        "AOT artifact adoption failed (%s); falling "
+                        "back to cached jit", e,
+                    )
+                    fn = traced
+                    self._aot_state = "fallback"
+                bound["fn"] = fn
+            return fn(*args)
+
+        self._jit_fn = aot_run
+
+    @staticmethod
+    def _bind_aot(traced, blobs, args):
+        from jax import export as jax_export
+
+        def aval(leaf):
+            return (tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+
+        want = [
+            aval(leaf)
+            for leaf in jax.tree_util.tree_leaves(
+                jax.eval_shape(lambda *a: a, *args)
+            )
+        ]
+        for blob in blobs:
+            exported = jax_export.deserialize(bytearray(blob))
+            if [aval(a) for a in exported.in_avals] != want:
+                continue
+            treedef = jax.tree_util.tree_structure(
+                jax.eval_shape(traced, *args)
+            )
+            call = exported.call
+            return lambda *a: jax.tree_util.tree_unflatten(
+                treedef, call(*a)
+            )
+        raise ValueError(
+            f"no preloaded AOT artifact matches input avals {want!r}"
         )
 
     def _build_candidate(self):

@@ -22,15 +22,19 @@ closes that gap for serving traffic:
   acceptance counters (no re-trace / no ladder re-run after warmup);
 - :mod:`snapshot` — durable warm-state snapshots (traced computations,
   resolved plan states, lowered graphs, kernel verdicts, AOT bucket
-  artifacts, fixed-keys probe digests) so a replica cold-starts warm
-  in seconds; the fleet layer above this package is ``bin/blitzen``
-  (graceful drain, ``/readyz``) + ``bin/donner`` (the routing front
-  door) — DEVELOP.md "Fleet serving".
+  artifacts — executed outright on restore — fixed-keys probe digests)
+  so a replica cold-starts warm in seconds; the fleet layer above this
+  package is ``bin/blitzen`` (graceful drain, ``/readyz``) +
+  ``bin/donner`` (the routing front door) — DEVELOP.md "Fleet serving";
+- :mod:`controlplane` — the continuous train -> canary -> promote /
+  auto-rollback loop over the fleet (DEVELOP.md "Continuous training
+  loop").
 
 Knobs: ``MOOSE_TPU_SERVE_MAX_BATCH`` / ``MOOSE_TPU_SERVE_MAX_WAIT_MS``
 / ``MOOSE_TPU_SERVE_QUEUE`` / ``MOOSE_TPU_SERVE_DEADLINE_MS`` (see
 :mod:`config`), ``MOOSE_TPU_SNAPSHOT_DIR`` / ``MOOSE_TPU_SNAPSHOT_AOT``
-(see :mod:`snapshot`).
+/ ``MOOSE_TPU_SNAPSHOT_AOT_EXEC`` (see :mod:`snapshot`),
+``MOOSE_TPU_CANARY_*`` (see :mod:`controlplane`).
 """
 
 from .config import ServingConfig
@@ -42,6 +46,13 @@ from .registry import (
     power_of_two_buckets,
 )
 from .batcher import ModelQueue
+from .controlplane import (
+    CanaryConfig,
+    ControlPlane,
+    HttpFleetClient,
+    LocalFleetClient,
+    SessionGenerationProducer,
+)
 from .server import InferenceServer
 from .snapshot import (
     current_snapshot_path,
@@ -50,12 +61,17 @@ from .snapshot import (
 )
 
 __all__ = [
+    "CanaryConfig",
+    "ControlPlane",
+    "HttpFleetClient",
     "InferenceServer",
+    "LocalFleetClient",
     "ModelQueue",
     "ModelRegistry",
     "RegisteredModel",
     "ServingConfig",
     "ServingMetrics",
+    "SessionGenerationProducer",
     "bucket_for",
     "current_snapshot_path",
     "power_of_two_buckets",

@@ -270,6 +270,18 @@ class ModelRegistry:
         self._models[name] = registered
         return registered
 
+    def unregister(self, name: str) -> RegisteredModel:
+        """Drop a registration (the control plane retiring a rolled-back
+        generation).  In-flight evaluations against the popped
+        ``RegisteredModel`` finish on its still-cached plans; only the
+        NAME disappears."""
+        if name not in self._models:
+            raise ConfigurationError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._models)}"
+            )
+        return self._models.pop(name)
+
     def evaluate(self, model: RegisteredModel, batch: np.ndarray):
         """One warm evaluation of a full (already padded) bucket.
         Returns (per-row outputs, eval_report) where the report carries

@@ -110,6 +110,20 @@ class InferenceServer:
             queue.model = registered
         return registered
 
+    def unregister_model(self, name: str) -> None:
+        """Retire a model (the control plane unloading a rolled-back
+        generation): close its queue — queued-but-undispatched requests
+        fail with a retryable ``ReplicaDrainingError`` so the router
+        resubmits them elsewhere — and drop the registration."""
+        queue = self._queues.pop(name, None)
+        if queue is None:
+            raise ConfigurationError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._queues)}"
+            )
+        queue.close()
+        self.registry.unregister(name)
+
     def load_snapshot(self, directory, source_digests=None,
                       rewarm: bool = True) -> dict:
         """Restore every model from the live warm-state snapshot under
@@ -135,13 +149,15 @@ class InferenceServer:
             )
         return report
 
-    def save_snapshot(self, directory, source_digests=None):
+    def save_snapshot(self, directory, source_digests=None, only=None):
         """Persist the warm registry (see :mod:`.snapshot`); returns the
-        new snapshot path."""
+        new snapshot path.  ``only`` restricts the snapshot to the named
+        models (drain-time snapshots exclude ephemeral control-plane
+        generations)."""
         from . import snapshot as snapshot_mod
 
         return snapshot_mod.save_snapshot(
-            self, directory, source_digests=source_digests
+            self, directory, source_digests=source_digests, only=only
         )
 
     def drain(self, timeout_s: float = 30.0) -> bool:

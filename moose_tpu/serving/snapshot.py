@@ -244,13 +244,18 @@ def _bucket_of_binding(key, input_name: str) -> Optional[int]:
     return None
 
 
-def _export_aot_buckets(runtime, model) -> Dict[int, Tuple[bytes, str]]:
+def _export_aot_buckets(
+    runtime, model
+) -> Dict[int, Tuple[bytes, str, str]]:
     """Try to AOT-serialize each bucket's resolved executable via
     ``jax.export``.  Only a plan promoted to whole-graph jit is a
     single exportable XLA program; everything else (segmented, per-op,
     eager, still-validating) records an ``unsupported:*`` verdict and
-    relies on plan-state restore + the persistent compilation cache."""
-    out: Dict[int, Tuple[bytes, str]] = {}
+    relies on plan-state restore + the persistent compilation cache.
+    Each value is ``(blob, verdict, plan_key)`` — the plan key lets the
+    restore side stash the artifact under the binding the runner will
+    actually look it up by."""
+    out: Dict[int, Tuple[bytes, str, str]] = {}
     if os.environ.get("MOOSE_TPU_SNAPSHOT_AOT", "1") == "0":
         return out
     try:
@@ -263,9 +268,12 @@ def _export_aot_buckets(runtime, model) -> Dict[int, Tuple[bytes, str]]:
         bucket = _bucket_of_binding(key, model.input_name)
         if bucket is None or bucket in out:
             continue
+        plan_key = getattr(runner, "_plan_key", "logical")
         if runner.mode != "jit" or runner.plan_mode != "whole-graph":
             out[bucket] = (
-                b"", f"unsupported:plan-{runner.plan_mode}-{runner.mode}"
+                b"",
+                f"unsupported:plan-{runner.plan_mode}-{runner.mode}",
+                plan_key,
             )
             continue
         try:
@@ -287,9 +295,11 @@ def _export_aot_buckets(runtime, model) -> Dict[int, Tuple[bytes, str]]:
             exported = jax_export.export(flat_fn)(
                 master_key_words("logical"), dyn
             )
-            out[bucket] = (exported.serialize(), "exported")
+            out[bucket] = (exported.serialize(), "exported", plan_key)
         except Exception as e:  # noqa: BLE001 — best-effort by contract
-            out[bucket] = (b"", f"unsupported:{type(e).__name__}")
+            out[bucket] = (
+                b"", f"unsupported:{type(e).__name__}", plan_key
+            )
     return out
 
 
@@ -311,12 +321,16 @@ def save_snapshot(
     server_or_registry,
     directory,
     source_digests: Optional[Dict[str, str]] = None,
+    only: Optional[set] = None,
 ) -> Path:
     """Write a complete warm-state snapshot of every registered model to
     ``directory`` and atomically repoint ``CURRENT`` at it.  Returns the
     new snapshot path.  ``source_digests`` (model name -> opaque digest
     of whatever the caller registered from, e.g. the ONNX bytes) become
-    load-time invalidation keys."""
+    load-time invalidation keys.  ``only`` restricts the snapshot to the
+    named models — a replica with ephemeral control-plane generations
+    loaded snapshots just its durable set, so the restore side's
+    source-digest set-equality check still holds."""
     from ..serde import serialize_computation
 
     registry = getattr(server_or_registry, "registry", server_or_registry)
@@ -342,6 +356,8 @@ def save_snapshot(
             "files": {},
         }
         for name in registry.names():
+            if only is not None and name not in only:
+                continue
             model = registry.get(name)
             entry = {
                 "input_name": model.input_name,
@@ -385,10 +401,10 @@ def save_snapshot(
                     "file": fname,
                     "plan_states": _plan_states_of(lowered),
                 })
-            for bucket, (blob, verdict) in _export_aot_buckets(
+            for bucket, (blob, verdict, plan_key) in _export_aot_buckets(
                 runtime, model
             ).items():
-                record = {"verdict": verdict}
+                record = {"verdict": verdict, "plan_key": plan_key}
                 if blob:
                     fname = f"{name}.aot.{bucket}"
                     _write_blob(stage, manifest, fname, blob)
@@ -654,12 +670,31 @@ def restore_registry(
             },
         )
         aot_verdicts = {}
+        aot_exec = os.environ.get(
+            "MOOSE_TPU_SNAPSHOT_AOT_EXEC", "1"
+        ) != "0"
         for bucket, record in (entry.get("aot") or {}).items():
             verdict = record.get("verdict", "")
             if verdict == "exported" and record.get("file"):
                 try:
                     verify_aot_artifact(blobs[record["file"]])
                     verdict = "restored"
+                    if aot_exec:
+                        # stash the artifact so the restored runner's
+                        # first call executes the exported program
+                        # outright (skipping even the cached compile);
+                        # the rewarm below proves bit-exactness against
+                        # the writer's probe digests as usual
+                        from ..execution.interpreter import (
+                            preload_aot_artifact,
+                        )
+
+                        preload_aot_artifact(
+                            comp,
+                            record.get("plan_key", "logical"),
+                            blobs[record["file"]],
+                        )
+                        verdict = "preloaded"
                 except Exception as e:  # noqa: BLE001 — degrade, never
                     # fail the whole snapshot over an optional artifact
                     verdict = f"unloadable:{type(e).__name__}"
@@ -686,14 +721,44 @@ def restore_registry(
                             "state is not bit-identical"
                         )
                     report["probe_checked"] += 1
+            # the rewarm just drove each bucket's first call: any
+            # preloaded artifact that bound is now the executing
+            # program — upgrade its verdict so callers can assert the
+            # exported program (not a recompile) served the probe
+            if "preloaded" in aot_verdicts.values():
+                for key, runner in _resolved_runners(runtime, comp):
+                    bucket = _bucket_of_binding(key, model.input_name)
+                    if (
+                        bucket is None
+                        or aot_verdicts.get(str(bucket)) != "preloaded"
+                    ):
+                        continue
+                    state = getattr(runner, "aot_state", None)
+                    if state == "adopted":
+                        aot_verdicts[str(bucket)] = "executed"
+                    elif state == "fallback":
+                        aot_verdicts[str(bucket)] = "restored"
         staged[name] = model
         report["models"].append(name)
     registry._models.update(staged)
     report["rewarm_s"] = time.perf_counter() - t0
+    from ..metrics import counter
+
+    aot_counter = counter(
+        "moose_tpu_serving_aot_buckets_total",
+        "AOT bucket artifacts by restore verdict",
+        labels=("verdict",),
+    )
+    executed = 0
+    for verdicts in report["aot"].values():
+        for verdict in verdicts.values():
+            aot_counter.inc(verdict=verdict.split(":", 1)[0])
+            executed += verdict == "executed"
     get_logger().info(
         "snapshot: restored %d model(s) from %s in %.2fs "
-        "(%d probe digest(s) verified, %d kernel verdict(s))",
+        "(%d probe digest(s) verified, %d kernel verdict(s), "
+        "%d AOT bucket(s) executing)",
         len(report["models"]), snapshot_path, report["rewarm_s"],
-        report["probe_checked"], restored_kernels,
+        report["probe_checked"], restored_kernels, executed,
     )
     return report

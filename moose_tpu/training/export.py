@@ -56,6 +56,19 @@ def trained_predictor(weights: np.ndarray,
     return from_onnx(logreg_onnx_bytes(weights, intercept))
 
 
+def onnx_digest(raw: bytes, n_features: int, max_batch: int) -> str:
+    """The fleet's source-digest formula for an ONNX artifact: what
+    blitzen stamps into snapshots (and the admin ``:load`` endpoint
+    answers for idempotency) — raw bytes plus the registration shape
+    knobs that change the warm state."""
+    import hashlib
+
+    return hashlib.blake2b(
+        bytes(raw) + repr((int(n_features), int(max_batch))).encode(),
+        digest_size=16,
+    ).hexdigest()
+
+
 def hot_swap(server: Any, name: str, weights: np.ndarray,
              intercept: Optional[np.ndarray] = None) -> Any:
     """Replace the live model ``name`` on an in-process

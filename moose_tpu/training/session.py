@@ -216,12 +216,18 @@ class TrainingSession:
 
     # -- the supervisor loop --------------------------------------------
 
-    def run(self, x: Any, y: Any) -> dict:
-        """Train to ``config.epochs`` committed epochs, resuming from
-        whatever is already durably committed.  Returns the report dict
-        (also kept as ``last_report``); trained weights under
-        ``"weights"`` when ``config.export``."""
+    def run(self, x: Any, y: Any,
+            epochs: Optional[int] = None) -> dict:
+        """Train to ``epochs`` (default ``config.epochs``) committed
+        epochs, resuming from whatever is already durably committed.
+        The override is the continuous-training lever: the control
+        plane calls ``run(x, y, epochs=N * epochs_per_generation)``
+        with a growing cumulative target, so each generation inherits
+        the committed state (and the mid-epoch resume machinery) of the
+        last.  Returns the report dict (also kept as ``last_report``);
+        trained weights under ``"weights"`` when ``config.export``."""
         cfg = self.config
+        target_epochs = cfg.epochs if epochs is None else int(epochs)
         trainer = self.trainer
         import numpy as np
 
@@ -230,7 +236,7 @@ class TrainingSession:
         n_rows = x.shape[0]
         report: dict = {
             "ok": False,
-            "target_epochs": cfg.epochs,
+            "target_epochs": target_epochs,
             "epochs_committed": [],
             "epochs_skipped": [],
             "resumes": 0,
@@ -252,16 +258,16 @@ class TrainingSession:
                 arguments=init_args,
             )
             base = 0
-        elif base > cfg.epochs:
+        elif base > target_epochs:
             raise CheckpointError(
                 f"checkpoint is already at epoch {base}, beyond the "
-                f"requested {cfg.epochs}"
+                f"requested {target_epochs}"
             )
         else:
             report["epochs_skipped"] = list(range(1, base + 1))
 
         epoch_comp = trainer.epoch_computation(n_rows)
-        while base < cfg.epochs:
+        while base < target_epochs:
             target = base + 1
             self._run_epoch(
                 report, epoch=target, comp=epoch_comp,

@@ -25,6 +25,10 @@ What it proves (the acceptance gates):
    affects share noise, so the probe never races open-loop traffic).
 5. **Graceful drain**: the SIGTERM'd replica answers 503+Retry-After
    during its drain, exits 0, and leaves a refreshed snapshot behind.
+6. **AOT-execute knob**: a final restore with
+   ``MOOSE_TPU_SNAPSHOT_AOT_EXEC=0`` re-warms bit-identically, reports
+   zero executed artifacts, and the summary carries the re-warm delta
+   between the exec and no-exec paths.
 
 Run time is dominated by replica A's fresh registration; B/C restore
 from the snapshot in seconds (MOOSE_TPU_JIT=0 here, like
@@ -85,12 +89,12 @@ def free_port() -> int:
 class Proc:
     """A replica/router subprocess with captured, greppable stdout."""
 
-    def __init__(self, name, argv):
+    def __init__(self, name, argv, extra_env=None):
         self.name = name
         self.lines = []
         self._lock = threading.Lock()
         self.popen = subprocess.Popen(
-            argv, env=ENV, cwd=ROOT, text=True,
+            argv, env={**ENV, **(extra_env or {})}, cwd=ROOT, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         threading.Thread(target=self._pump, daemon=True).start()
@@ -161,14 +165,14 @@ def wait_ready(base, timeout_s=600):
     )
 
 
-def start_replica(name, port, onnx_path, snapshot_dir):
+def start_replica(name, port, onnx_path, snapshot_dir, extra_env=None):
     return Proc(name, [
         sys.executable, "-m", "moose_tpu.bin.blitzen",
         f"logreg={onnx_path}", "--features", f"logreg={FEATURES}",
         "--host", "127.0.0.1", "--port", str(port),
         "--snapshot-dir", str(snapshot_dir),
         "--drain-timeout-s", "60",
-    ])
+    ], extra_env=extra_env)
 
 
 def prom_value(text, name):
@@ -465,6 +469,47 @@ def main():
         )
         assert ejections and ejections >= 1, donner_prom
         assert readmissions and readmissions >= 1, donner_prom
+
+        # ---- phase 9: AOT-execute re-warm delta — restart replica B
+        # once more with the restored-artifact execution path disabled
+        # (MOOSE_TPU_SNAPSHOT_AOT_EXEC=0) and compare re-warm times.
+        # Under MOOSE_TPU_JIT=0 both restores are compile-free and the
+        # delta is noise; on the compiled path the exec'd artifact
+        # skips even the cached compile (tests/test_fleet.py proves the
+        # "executed" verdict + bit-exactness; bench.py measures it on
+        # real hardware).  Either way the knob and both restore paths
+        # are exercised end-to-end here.
+        procs["b2"].sigterm()
+        procs["b2"].popen.wait(timeout=300)
+        procs["b3"] = start_replica(
+            "b3", ports["b"], onnx_path, snapshot_dir,
+            extra_env={"MOOSE_TPU_SNAPSHOT_AOT_EXEC": "0"},
+        )
+        wait_ready(bases["b"])
+        m = wait_until(
+            lambda: procs["b3"].grep(
+                r"restored warm state from .* in ([0-9.]+)s "
+                r"\((\d+) probe digest\(s\) verified, (\d+) AOT "
+                r"bucket\(s\) executed\)"
+            ),
+            60, "aot-exec-disabled restore banner",
+        )
+        summary["rewarm_aot_exec_s"] = summary["rewarm_after_kill_s"]
+        summary["rewarm_aot_noexec_s"] = float(m.group(1))
+        summary["rewarm_aot_delta_s"] = (
+            summary["rewarm_aot_noexec_s"]
+            - summary["rewarm_aot_exec_s"]
+        )
+        assert int(m.group(3)) == 0, (
+            "MOOSE_TPU_SNAPSHOT_AOT_EXEC=0 must disable artifact "
+            "execution"
+        )
+        status, body = http_post(
+            bases["b"] + "/v1/models/logreg:predict", {"x": probe_x}
+        )
+        assert status == 200 and body == probe_bytes["a"], (
+            "aot-exec-disabled restore diverged bitwise"
+        )
 
         latencies = sorted(o["latency_s"] for o in done)
         summary.update({

@@ -637,6 +637,9 @@ def test_snapshot_jit_plan_state_and_aot_end_to_end(
         s["mode"] == "jit" for s in entry["plan_states"].values()
     ), entry["plan_states"]
     probe = _probe_rows(2, (FEATURES,))
+    y_live, _ = server.registry.evaluate(
+        server.registry.get("m"), probe
+    )
     aot = entry["aot"].get("2", {})
     if aot.get("verdict") == "exported":  # whole-graph plans only
         call = verify_aot_artifact(
@@ -645,9 +648,6 @@ def test_snapshot_jit_plan_state_and_aot_end_to_end(
         leaves = call(
             master_key_words("logical"),
             {entry["input_name"]: jnp.asarray(probe)},
-        )
-        y_live, _ = server.registry.evaluate(
-            server.registry.get("m"), probe
         )
         assert any(
             np.array_equal(np.asarray(leaf), y_live)
@@ -664,8 +664,19 @@ def test_snapshot_jit_plan_state_and_aot_end_to_end(
         tmp_path, source_digests={"m": "j"}
     )
     assert report["probe_checked"] >= 1
+    if aot.get("verdict") == "exported":
+        # the restored artifact doesn't just verify — it EXECUTES,
+        # replacing even the cached compile for that bucket
+        assert report["aot"]["m"].get("2") == "executed", report["aot"]
+    # ... and the restored replica serves bit-identically to the live
+    # pre-snapshot path without a single re-trace or ladder re-entry
+    y_restored, _ = restored.registry.evaluate(
+        restored.registry.get("m"), probe
+    )
+    assert np.array_equal(y_restored, y_live)
     snap = restored.metrics_snapshot()
     assert snap["validating_after_warm"] == 0
+    assert snap["retraces_after_warm"] == 0
     restored.close()
 
 
