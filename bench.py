@@ -1123,6 +1123,83 @@ def bench_training(features=8, rows=32, epochs=3):
     return record
 
 
+def bench_fabric_training(features=8, rows=32, iters=3):
+    """Fabric-vs-gRPC training-epoch bench (ISSUE 19, BENCH_r11+): the
+    SAME warm 3-party logreg SGD step session timed over a plain gRPC
+    cluster and over ONE FabricDomain (every cross-party edge a
+    collective permute instead of serde + wire).  Records the headline
+    ``training_epoch_fabric_vs_grpc`` speedup plus the transport /
+    trust_model each row rode (BENCH hygiene: ROADMAP's trust_model
+    field is now recorded per row, not implied)."""
+    from moose_tpu.dialects import host as host_dialect
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+    from moose_tpu.distributed.fabric import FabricDomain
+    from moose_tpu.predictors.trainers import LogregSGDTrainer
+
+    parties = ["alice", "bob", "carole"]
+    trainer = LogregSGDTrainer(n_features=features)
+    comp = trainer.step_computation(rows)
+    rng = np.random.default_rng(5)
+    args = {
+        "x": rng.normal(size=(rows, features)) * 0.5,
+        "y": (rng.uniform(size=(rows, 1)) > 0.5).astype(np.float64),
+        "w": np.zeros((features, 1)),
+    }
+
+    def timed_epochs(fabric_domain):
+        servers, endpoints = start_local_cluster(
+            parties, receive_timeout=30.0, startup_grace=10.0,
+            fabric_domain=fabric_domain,
+        )
+        try:
+            client = GrpcClientRuntime(endpoints, max_attempts=2)
+            # pin the compile-time seed-derivation nonces so both
+            # transports run the SAME lowered graph bytes
+            with host_dialect.deterministic_sync_keys(1234):
+                # two warmups: the first session compiles, the second
+                # lets the worker plan ladder settle on its jit plan
+                client.run_computation(comp, args, timeout=600.0)
+                client.run_computation(comp, args, timeout=600.0)
+                times = []
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    outputs, _ = client.run_computation(
+                        comp, args, timeout=600.0
+                    )
+                    times.append(time.perf_counter() - t0)
+            report = dict(client.last_session_report)
+            return float(np.median(times)), outputs, report
+        finally:
+            for srv in servers.values():
+                srv.stop()
+
+    grpc_s, grpc_out, grpc_report = timed_epochs(None)
+    domain = FabricDomain.default(parties, trust_model="simulation")
+    fabric_s, fabric_out, fabric_report = timed_epochs(domain)
+    # numerical gate: wrong-but-fast numbers are not publishable (the
+    # transports differ only by share-mask draws, never by magnitude)
+    for name in grpc_out:
+        a = np.asarray(grpc_out[name])
+        b = np.asarray(fabric_out[name])
+        assert np.allclose(a, b, atol=1e-3), (name, a, b)
+    return {
+        "training_epoch_grpc_s": grpc_s,
+        "training_epoch_fabric_s": fabric_s,
+        "training_epoch_fabric_vs_grpc": grpc_s / fabric_s,
+        "training_epoch_rows": {
+            "grpc": {
+                "transport": grpc_report.get("transport"),
+                "trust_model": grpc_report.get("trust_model"),
+            },
+            "fabric": {
+                "transport": fabric_report.get("transport"),
+                "trust_model": fabric_report.get("trust_model"),
+            },
+        },
+    }
+
+
 def bench_controlplane(features=8, rows=16, cycles=2):
     """Continuous-training-loop bench (ISSUE 18, BENCH_r10+): the full
     control-plane cycle — a resumable 3-party TrainingSession produces
@@ -1530,6 +1607,16 @@ def main():
             emit()
     except Exception as e:
         print(f"# training bench failed: {e}")
+
+    # fabric transport (ISSUE 19, BENCH_r11+): the same warm logreg
+    # epoch over ONE FabricDomain vs the plain gRPC cluster —
+    # collective permutes vs serde + wire on every cross-party edge
+    try:
+        if _within_budget():
+            record.update(bench_fabric_training())
+            emit()
+    except Exception as e:
+        print(f"# fabric training bench failed: {e}")
 
     # continuous-training control plane (ISSUE 18, BENCH_r10+): the
     # full train -> stage -> canary -> promote cycle against a live

@@ -90,6 +90,19 @@ def _teardown(procs):
             p.kill()
 
 
+def _transport_fields(runtime) -> dict:
+    """Bench-row hygiene (ISSUE 19): every distributed row says which
+    transport it rode and under which trust-model attestation, straight
+    from the session report (subprocess comet workers cannot share a
+    device mesh, so these rows always say grpc — the field makes that
+    explicit instead of implied)."""
+    report = getattr(runtime, "last_session_report", None) or {}
+    return {
+        "transport": report.get("transport"),
+        "trust_model": report.get("trust_model"),
+    }
+
+
 def build_dot_comp(pm, n_seq):
     alice = pm.host_placement("alice")
     bob = pm.host_placement("bob")
@@ -144,6 +157,7 @@ def bench_dot(runtime, pm, size, n_seq, iters):
         "min": round(min(times), 4),
         "max": round(max(times), 4),
         "iters": iters,
+        **_transport_fields(runtime),
     }
 
 
@@ -188,6 +202,7 @@ def bench_logreg(runtime, pm, batch_size, n_iter, iters):
         "min": round(min(times), 4),
         "max": round(max(times), 4),
         "iters": iters,
+        **_transport_fields(runtime),
     }
 
 

@@ -197,6 +197,41 @@ def _placeholder(spec: ValueSpec) -> Any:
     return None
 
 
+_FABRIC_PAYLOAD_CACHE: Dict[Tuple[Any, ...], Optional[Tuple[int, int]]] = {}
+
+
+def fabric_payload(spec: ValueSpec) -> Optional[Tuple[int, int]]:
+    """``(array leaf count, device bytes)`` a fabric transfer of a value
+    matching ``spec`` moves — computed with the SAME tree-leaf + nbytes
+    accounting the runtime applies (``distributed.fabric.value_leaves``
+    / ``leaf_bytes``) on the zero placeholder, which is what makes
+    predicted fabric bytes equal measured counter deltas exactly.  A
+    leaf count of 0 (HostUnit/HostShape/HostString) is the passthrough
+    case: no permute, zero bytes, on both sides of the prediction."""
+    token = _cache_token(spec)
+    if token in _FABRIC_PAYLOAD_CACHE:
+        return _FABRIC_PAYLOAD_CACHE[token]
+    placeholder = _placeholder(spec)
+    result: Optional[Tuple[int, int]] = None
+    if placeholder is not None:
+        from ...distributed.fabric import leaf_bytes, value_leaves
+
+        leaves = value_leaves(placeholder)
+        result = (len(leaves), leaf_bytes(leaves))
+    _FABRIC_PAYLOAD_CACHE[token] = result
+    return result
+
+
+def fabric_hops(fabric_parties: Sequence[str], sender: str,
+                receiver: str) -> int:
+    """MSA6xx permute distance: ring hops between mesh positions, in
+    the domain's declaration order (mirrors ``FabricDomain.hops``)."""
+    order = list(fabric_parties)
+    n = len(order)
+    d = (order.index(receiver) - order.index(sender)) % n
+    return min(d, n - d) or n
+
+
 def memory_bytes(spec: ValueSpec) -> Optional[int]:
     """In-memory footprint (device/host array bytes, not wire bytes)."""
     if spec.kind in ("seed", "key"):
@@ -660,6 +695,7 @@ def cost_report(
     coalesce: bool = True,
     schedules: Optional[Dict[str, RoleSchedule]] = None,
     arg_ranges: Optional[Dict[str, Tuple[float, float]]] = None,
+    fabric_parties: Optional[Sequence[str]] = None,
 ) -> Dict[str, Any]:
     """The machine-readable plan report: predicted per-party wire
     counters for ONE session under ``transport`` semantics, plus
@@ -670,6 +706,14 @@ def cost_report(
     prices the legacy eager scheduler (every send a singleton).
     Predictions match the runtime metrics registry exactly — the
     ``dist_smoke`` CI gate asserts it.
+
+    ``transport="fabric"`` prices edges whose BOTH endpoints are in
+    ``fabric_parties`` (an ordered tuple — ring position = hop count)
+    as collective permutes: device leaf bytes with no serde framing,
+    one permute per flush bucket (batched when the bucket coalesces
+    more than one array-bearing payload), plus a ``fabric_cost`` of
+    bytes x ring hops per transfer.  Edges crossing the domain boundary
+    keep exact gRPC frame pricing — mixed sessions stay exact.
 
     When ``arg_ranges`` declares real-space input bounds, the report
     gains a ``ranges`` block (the MSA704 per-value precision report) —
@@ -686,6 +730,10 @@ def cost_report(
     specs = infer_specs(comp, arg_specs)
 
     parties = sorted(schedules)
+    fabric_order: Tuple[str, ...] = tuple(fabric_parties or ())
+    if transport == "fabric" and not fabric_order:
+        fabric_order = tuple(parties)
+    fabric_members = frozenset(fabric_order)
     per_party: Dict[str, Dict[str, Any]] = {
         p: {
             "tx_bytes": 0, "rx_bytes": 0, "sends": 0,
@@ -694,7 +742,21 @@ def cost_report(
         }
         for p in parties
     }
+    if transport == "fabric":
+        for p in parties:
+            per_party[p].update({
+                "fabric_permutes": 0, "fabric_batched_permutes": 0,
+                "fabric_permute_payloads": 0, "fabric_tx_bytes": 0,
+                "fabric_cost": 0, "fallback_sends": 0,
+            })
     resolved = True
+
+    def _fabric_edge(sender: str, receiver: str) -> bool:
+        return (
+            transport == "fabric"
+            and sender in fabric_members
+            and receiver in fabric_members
+        )
 
     def _payload(send_name: str) -> Optional[int]:
         op = comp.operations[send_name]
@@ -719,6 +781,48 @@ def cost_report(
             ]
         for group in flush_groups:
             for receiver, names in _group_by_receiver(comp, group):
+                if _fabric_edge(party, receiver):
+                    fsizes = [
+                        fabric_payload(specs.get(
+                            comp.operations[n].inputs[0], UNKNOWN
+                        )) if comp.operations[n].inputs else None
+                        for n in names
+                    ]
+                    if any(s is None for s in fsizes):
+                        resolved = False
+                        stats["unresolved_sends"].extend(
+                            n for n, s in zip(names, fsizes)
+                            if s is None
+                        )
+                        continue
+                    leafy = [s for s in fsizes
+                             if s is not None and s[0] > 0]
+                    total_bytes = sum(b for _, b in leafy)
+                    if len(names) > 1 and coalesce:
+                        # FabricNetworking.send_many: one batched
+                        # permute moves every array-bearing payload
+                        stats["send_many_envelopes"] += 1
+                        stats["send_many_payloads"] += len(names)
+                        if leafy:
+                            stats["fabric_permutes"] += 1
+                            stats["fabric_permute_payloads"] += len(
+                                leafy
+                            )
+                            if len(leafy) > 1:
+                                stats["fabric_batched_permutes"] += 1
+                    else:
+                        # singleton send(): one permute per array-
+                        # bearing payload, passthrough for the rest
+                        stats["sends"] += len(names)
+                        stats["fabric_permutes"] += len(leafy)
+                        stats["fabric_permute_payloads"] += len(leafy)
+                    stats["fabric_tx_bytes"] += total_bytes
+                    stats["tx_bytes"] += total_bytes
+                    stats["fabric_cost"] += total_bytes * fabric_hops(
+                        fabric_order, party, receiver
+                    )
+                    per_party[receiver]["rx_bytes"] += total_bytes
+                    continue
                 sizes = [_payload(n) for n in names]
                 if any(s is None for s in sizes):
                     resolved = False
@@ -738,10 +842,14 @@ def cost_report(
                     )
                     for n, s in zip(names, sizes)
                 ]
+                if transport == "fabric":
+                    # an edge crossing the trust boundary: exact wire
+                    # (gRPC frame) pricing, tallied as fallbacks
+                    stats["fallback_sends"] += len(names)
                 if len(names) > 1 and coalesce:
                     stats["send_many_envelopes"] += 1
                     stats["send_many_payloads"] += len(names)
-                    if transport == "grpc":
+                    if transport in ("grpc", "fabric"):
                         frame = len(pack_batch_frame(party, entries))
                         stats["tx_bytes"] += frame
                         per_party[receiver]["rx_bytes"] += frame
@@ -757,7 +865,7 @@ def cost_report(
                 else:
                     for (key, payload_blob), name in zip(entries, names):
                         stats["sends"] += 1
-                        if transport == "grpc":
+                        if transport in ("grpc", "fabric"):
                             frame = len(pack_value_frame(
                                 party, key, payload_blob
                             ))
@@ -781,12 +889,19 @@ def cost_report(
                 "validatable": seg.validatable,
             })
 
+    total_keys = [
+        "tx_bytes", "rx_bytes", "sends", "send_many_envelopes",
+        "send_many_payloads", "receives",
+    ]
+    if transport == "fabric":
+        total_keys += [
+            "fabric_permutes", "fabric_batched_permutes",
+            "fabric_permute_payloads", "fabric_tx_bytes",
+            "fabric_cost", "fallback_sends",
+        ]
     totals = {
         k: sum(int(per_party[p][k]) for p in parties)
-        for k in (
-            "tx_bytes", "rx_bytes", "sends", "send_many_envelopes",
-            "send_many_payloads", "receives",
-        )
+        for k in total_keys
     }
     report = {
         "transport": transport,
@@ -796,6 +911,8 @@ def cost_report(
         "per_party": per_party,
         "totals": totals,
     }
+    if transport == "fabric":
+        report["fabric_parties"] = list(fabric_order)
     if arg_ranges is not None:
         from .ranges import range_report
 

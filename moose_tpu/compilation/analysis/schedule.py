@@ -44,6 +44,10 @@ Rules:
   segments (below ``MOOSE_TPU_WORKER_MIN_SEG``, or carrying
   dynamic-shape kinds), paying a host/device crossing per input per
   evaluation.
+- ``MSA505`` (error): fabric-lowered schedule not provably
+  deadlock-free — run only when a FabricDomain claims a session (see
+  :func:`analyze_fabric_schedules`); rejection makes the fabric
+  transport fall back to the wire on every edge of the computation.
 
 On graphs with composite placements (pre-lowering) or without any
 Send/Receive op (single-role / pre-networking) the analysis is a no-op,
@@ -55,7 +59,16 @@ from __future__ import annotations
 import dataclasses
 import os
 import weakref
-from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+from typing import (
+    Any,
+    Dict,
+    FrozenSet,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 from ...computation import Computation, HostPlacement
 from .diagnostics import Diagnostic, Severity
@@ -69,6 +82,7 @@ __all__ = [
     "RoleSchedule",
     "SegmentPlan",
     "Step",
+    "analyze_fabric_schedules",
     "analyze_schedule",
     "analyze_schedules",
     "build_role_schedule",
@@ -719,6 +733,140 @@ def _check_boundary_straddle(
     return diagnostics
 
 
+def analyze_fabric_schedules(
+    comp: Computation,
+    schedules: Dict[str, RoleSchedule],
+    fabric_parties: FrozenSet[str],
+) -> List[Diagnostic]:
+    """MSA505: deadlock-freedom of the FABRIC-lowered schedule.
+
+    When both endpoints of an edge are members of one
+    :class:`~moose_tpu.distributed.fabric.FabricDomain`, the transfer is
+    a collective permute on a shared device fabric instead of a buffered
+    wire frame.  That is a stronger execution model than the one MSA501
+    proves: collectives on one fabric edge retire in launch order, a
+    coalesced flush group is ONE batched program (all payloads or
+    none), and under the ``colocated_tee`` trust model both endpoint
+    parties must issue matching collectives in the same order.  The
+    fabric therefore refuses any schedule it cannot prove under three
+    rules, each reported as an ``MSA505`` error (the runtime falls back
+    to the wire on rejection — fallback is graceful, entering an
+    unprovable collective schedule is not):
+
+    1. the MSA501 wait-graph fixed point must already hold (a schedule
+       the wire would hang on is certainly not fabric-safe);
+    2. no two intra-fabric Sends may share a rendezvous key — a second
+       permute program racing into a consumed rendezvous cell is a
+       silent payload loss, where the wire's duplicate frame is merely
+       dropped;
+    3. per fabric edge (sender party -> receiver party), the receiver's
+       wait order must not invert the sender's flush order for any key
+       pair — inverted collectives on one ordered channel are the
+       classic issue-order deadlock.
+
+    Public and pure over explicit ``schedules`` so tests can hand the
+    rule schedules the by-construction-safe reconstruction could never
+    produce (the plan-build-time gate in ``FabricNetworking.
+    prepare_fabric`` calls this with the worker's reconstructed
+    schedules)."""
+    if not _analyzable(comp):
+        return []
+    fabric_parties = frozenset(fabric_parties)
+    ops = comp.operations
+    diagnostics: List[Diagnostic] = []
+
+    def _receiver_of(name: str) -> Optional[str]:
+        return ops[name].attributes.get("receiver")
+
+    # rule 1: the wire fixed point, re-coded — the fabric gate runs at
+    # plan-build time per session and must reject on its own authority
+    for d in _check_wait_graph(comp, schedules):
+        diagnostics.append(Diagnostic(
+            "MSA505", Severity.ERROR,
+            "fabric lowering refused: the underlying wait graph is "
+            f"already unsatisfiable — {d.message}",
+            op=d.op, placement=d.placement,
+        ))
+
+    # rule 2: duplicate intra-fabric sends on one rendezvous key
+    fabric_sends: Dict[str, List[str]] = {}
+    for role, sched in schedules.items():
+        if role not in fabric_parties:
+            continue
+        for name in sched.exec_step:
+            op = ops[name]
+            if op.kind != "Send":
+                continue
+            key = op.attributes.get("rendezvous_key")
+            receiver = _receiver_of(name)
+            if isinstance(key, str) and receiver in fabric_parties:
+                fabric_sends.setdefault(key, []).append(name)
+    for key, names in sorted(fabric_sends.items()):
+        if len(names) > 1:
+            diagnostics.append(Diagnostic(
+                "MSA505", Severity.ERROR,
+                f"rendezvous key {key!r} has {len(names)} intra-fabric "
+                f"Sends ({sorted(names)}); a second collective permute "
+                "racing into a consumed rendezvous cell is a silent "
+                "payload loss on the fabric",
+                op=sorted(names)[1],
+            ))
+
+    # rule 3: per-edge launch-order consistency.  Flush order = the
+    # order send steps complete in the sender's schedule ("sends"
+    # groups flush in payload order); wait order = the receiver's
+    # receive steps in step order.
+    flush_order: Dict[Tuple[str, str], List[str]] = {}
+    wait_order: Dict[Tuple[str, str], List[str]] = {}
+    for role, sched in schedules.items():
+        if role not in fabric_parties:
+            continue
+        for kind, payload in sched.steps:
+            names: Sequence[str]
+            if kind == "sends":
+                names = [str(n) for n in payload]
+            elif kind == "op" and ops[str(payload)].kind in (
+                "Send", "Receive"
+            ):
+                names = [str(payload)]
+            else:
+                continue
+            for name in names:
+                op = ops[name]
+                key = op.attributes.get("rendezvous_key")
+                if not isinstance(key, str):
+                    continue
+                if op.kind == "Send":
+                    receiver = _receiver_of(name)
+                    if receiver in fabric_parties:
+                        flush_order.setdefault(
+                            (role, str(receiver)), []
+                        ).append(key)
+                else:
+                    sender = op.attributes.get("sender")
+                    if sender in fabric_parties:
+                        wait_order.setdefault(
+                            (str(sender), role), []
+                        ).append(key)
+    for edge in sorted(set(flush_order) & set(wait_order)):
+        flushed = flush_order[edge]
+        flush_pos = {k: i for i, k in enumerate(flushed)}
+        waited = [k for k in wait_order[edge] if k in flush_pos]
+        for a, b in zip(waited, waited[1:]):
+            if flush_pos[a] > flush_pos[b]:
+                diagnostics.append(Diagnostic(
+                    "MSA505", Severity.ERROR,
+                    f"fabric edge {edge[0]}->{edge[1]}: receiver waits "
+                    f"key {a!r} before {b!r} but the sender launches "
+                    f"their permutes in the opposite order; inverted "
+                    "collectives on one ordered channel are an "
+                    "issue-order deadlock",
+                    placement=edge[1],
+                ))
+                break  # one inversion per edge is enough to reject
+    return diagnostics
+
+
 RULES = {
     "MSA501": "unsatisfiable wait in the segment-level plan (sequential "
               "orchestrator would hang: wait cycle, blocked or missing "
@@ -729,4 +877,8 @@ RULES = {
               "it (receive arrives later than first use)",
     "MSA504": "jit-candidate segment consumes always-eager sliver-"
               "segment outputs (host/device crossing per input)",
+    "MSA505": "fabric-lowered schedule not provably deadlock-free "
+              "(unsatisfiable wait graph, duplicate intra-fabric "
+              "rendezvous key, or inverted per-edge collective launch "
+              "order); the fabric transport falls back to the wire",
 }

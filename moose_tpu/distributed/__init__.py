@@ -1,4 +1,19 @@
 """Distributed execution: networking backends, role-filtered workers,
-choreography, the client session supervisor, and the deterministic
-chaos layer (reference ``moose/src/networking``,
+choreography, the client session supervisor, the fabric transport
+(parties as mesh slices exchanging values via collective permutes), and
+the deterministic chaos layer (reference ``moose/src/networking``,
 ``moose/src/choreography``, ``moose/src/execution/grpc.rs``)."""
+
+from typing import Any
+
+__all__ = ["FabricDomain", "FabricNetworking"]
+
+
+def __getattr__(name: str) -> Any:
+    # lazy re-export: importing the package must not drag jax in before
+    # the caller has set XLA_FLAGS / JAX_PLATFORMS for virtual devices
+    if name in __all__:
+        from . import fabric
+
+        return getattr(fabric, name)
+    raise AttributeError(name)

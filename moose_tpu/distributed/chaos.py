@@ -337,6 +337,15 @@ class ChaosNetworking:
                 "drop_send", _session=session_id, key=rendezvous_key,
                 party=self._identity,
             )
+            # fabric transports: the dropped key's REPLAY must not
+            # re-enter a collective whose payload was already lost —
+            # latch it onto the wire path (stable key, so the latch
+            # survives the supervisor's fresh session id).  The fault
+            # record itself gains no transport field: a chaos seed's
+            # schedule digest is identical with the fabric on or off.
+            force_wire = getattr(self._inner, "force_wire", None)
+            if force_wire is not None:
+                force_wire(rendezvous_key)
             return None  # swallowed: the receiver never hears of it
         result = self._inner.send(
             value, receiver, rendezvous_key, session_id, **kwargs

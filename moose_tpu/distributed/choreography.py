@@ -93,7 +93,8 @@ class WorkerServer:
                  startup_grace: float = 30.0,
                  receive_timeout: Optional[float] = None,
                  stall_grace: Optional[float] = None,
-                 chaos=None, metrics_port: Optional[int] = None):
+                 chaos=None, metrics_port: Optional[int] = None,
+                 fabric_domain=None):
         self.identity = identity
         self.port = port
         self.endpoints = dict(endpoints)
@@ -145,6 +146,18 @@ class WorkerServer:
 
         self.chaos = chaos if chaos is not None else ChaosConfig.from_env()
         networking = GrpcNetworking(identity, self.endpoints, tls=tls)
+        # layering: wire -> fabric -> chaos.  The fabric lowers
+        # intra-domain edges to collective permutes over the wire's
+        # cell store; chaos stays OUTERMOST so fault decisions happen
+        # per logical rendezvous key BEFORE permute lowering (a dropped
+        # key latches onto the wire for its replay).
+        self.fabric_domain = fabric_domain
+        if fabric_domain is not None and fabric_domain.is_member(identity):
+            from .fabric import FabricNetworking
+
+            networking = FabricNetworking(
+                fabric_domain, identity, networking
+            )
         if self.chaos is not None:
             self.chaos.register_kill_hook(identity, self._chaos_kill)
             networking = self.chaos.wrap(networking, identity)
@@ -276,6 +289,16 @@ class WorkerServer:
                     progress=state.progress,
                     timeout=self.receive_timeout,
                 )
+                # resolved transport descriptor rides along so the
+                # client's session report (and bench rows) record what
+                # this party's traffic actually used
+                descriptor = getattr(
+                    self.networking, "transport_descriptor", None
+                )
+                transport = (
+                    descriptor() if descriptor is not None
+                    else {"transport": "grpc", "trust_model": None}
+                )
                 payload = _pack({
                     "outputs": {
                         name: _serialize_output(value)
@@ -287,6 +310,8 @@ class WorkerServer:
                     # assert every role reached its compiled plan
                     "plan_mode": result.get("plan_mode"),
                     "pinned_segments": result.get("pinned_segments", []),
+                    "transport": transport.get("transport"),
+                    "trust_model": transport.get("trust_model"),
                 })
                 flight.record(
                     "session_completed", party=self.identity,

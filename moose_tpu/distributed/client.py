@@ -286,6 +286,7 @@ class GrpcClientRuntime:
         outputs: dict = {}
         timings: dict = {}
         plan_modes: dict = {}
+        transports: dict = {}
         try:
             done, pending = futures_wait(
                 futs, timeout=timeout + 15.0,
@@ -310,6 +311,11 @@ class GrpcClientRuntime:
                         "pinned_segments": result.get(
                             "pinned_segments", []
                         ),
+                    }
+                if result.get("transport") is not None:
+                    transports[name] = {
+                        "transport": result["transport"],
+                        "trust_model": result.get("trust_model"),
                     }
                 for out_name, blob in (
                     result.get("outputs") or {}
@@ -341,7 +347,7 @@ class GrpcClientRuntime:
                 raise first_error
         finally:
             pool.shutdown(wait=False)
-        return outputs, timings, plan_modes
+        return outputs, timings, plan_modes, transports
 
     def _collect_flight(self, session_ids) -> list:
         """Gather every party's recent flight-recorder events for the
@@ -499,7 +505,7 @@ class GrpcClientRuntime:
                                     trace=trace_ctx.to_dict(),
                                 )
                             with telemetry.span("retrieve"):
-                                outputs, timings, plan_modes = (
+                                outputs, timings, plan_modes, transports = (
                                     self._retrieve_all(
                                         session_id, timeout, attempt_rec
                                     )
@@ -575,4 +581,21 @@ class GrpcClientRuntime:
         # resolved per-role worker plans (worker_plan): the distributed
         # mirror of LocalMooseRuntime.last_plan's plan_mode/pinned_ops
         report["plan_modes"] = dict(plan_modes)
+        # resolved transport per party, plus the session-level rollup
+        # ("fabric" / "grpc" / "mixed") and trust model — BENCH rows and
+        # postmortems must say what the traffic actually rode on
+        report["transports"] = dict(transports)
+        kinds = {t["transport"] for t in transports.values()}
+        report["transport"] = (
+            (kinds.pop() if len(kinds) == 1 else "mixed")
+            if kinds else None
+        )
+        models = {
+            t.get("trust_model") for t in transports.values()
+            if t.get("trust_model")
+        }
+        report["trust_model"] = (
+            models.pop() if len(models) == 1
+            else (sorted(models) if models else None)
+        )
         return outputs, timings

@@ -248,6 +248,13 @@ def execute_role(
     t0 = time.perf_counter()
     arguments = arguments or {}
     validate_deployable(comp)
+    # fabric transports resolve this computation's rendezvous keys to
+    # permute schedules at plan-build time (MSA505 deadlock gate; a
+    # rejected computation is latched wire-only for the session) —
+    # delegates through proxy transports like ChaosNetworking
+    prepare_fabric = getattr(networking, "prepare_fabric", None)
+    if prepare_fabric is not None:
+        prepare_fabric(comp, session_id)
     if progress is None:
         progress = ProgressClock()
 

@@ -247,13 +247,16 @@ def _watchdog_transport(networking) -> Optional[str]:
     name = type(networking).__name__
     if name == "GrpcNetworking":
         return "grpc"
+    if name == "FabricNetworking":
+        return "fabric"
     if name == "LocalNetworking":
         return "local" if getattr(networking, "_serialize", False) else None
     return None
 
 
-def _cost_prediction(comp, transport: str, session_id: str):
-    key = (transport, len(session_id))
+def _cost_prediction(comp, transport: str, session_id: str,
+                     fabric_ctx=None):
+    key = (transport, len(session_id), fabric_ctx)
     with _cache_lock:
         per_comp = _cost_cache.get(comp)
         if per_comp is None:
@@ -264,7 +267,10 @@ def _cost_prediction(comp, transport: str, session_id: str):
     from ..compilation.analysis.cost import cost_report, infer_specs
 
     entry = (
-        cost_report(comp, session_id=session_id, transport=transport),
+        cost_report(
+            comp, session_id=session_id, transport=transport,
+            fabric_parties=fabric_ctx[0] if fabric_ctx else None,
+        ),
         infer_specs(comp),
     )
     with _cache_lock:
@@ -325,7 +331,18 @@ def check_cost_drift(comp, identity: str, session_id: str, networking,
         if transport is None:
             _watchdog_counter().inc(outcome="skipped")
             return None
-        report, specs = _cost_prediction(comp, transport, session_id)
+        fabric_ctx = None
+        if transport == "fabric":
+            # None when the fabric is disabled or chaos force-wire
+            # latches make the edge set key-dependent — no exact
+            # prediction exists then, so the watchdog stands down
+            fabric_ctx = networking.fabric_cost_context()
+            if fabric_ctx is None:
+                _watchdog_counter().inc(outcome="skipped")
+                return None
+        report, specs = _cost_prediction(
+            comp, transport, session_id, fabric_ctx
+        )
         party = report["per_party"].get(identity)
         if party is None or party["unresolved_sends"]:
             _watchdog_counter().inc(outcome="skipped")
@@ -335,9 +352,11 @@ def check_cost_drift(comp, identity: str, session_id: str, networking,
             "send_many_envelopes": stats["envelopes"],
             "send_many_payloads": stats["env_payloads"],
             # local transports count coalesced payloads as sends too
-            # (send_many delegates to send); grpc sends one rpc frame
+            # (send_many delegates to send); grpc sends one rpc frame,
+            # and a fabric envelope is one batched permute program
             "sends": stats["singles"] + (
-                stats["env_payloads"] if transport != "grpc" else 0
+                stats["env_payloads"]
+                if transport not in ("grpc", "fabric") else 0
             ),
             "receives": int(receives),
         }

@@ -862,6 +862,21 @@ def make_mesh(n_devices: Optional[int] = None, devices=None):
     return jax.sharding.Mesh(arr, ("parties", "data"))
 
 
+def fabric_party_mesh(devices):
+    """1-D mesh over axis ``"parties"`` — one lead device per party, in
+    the FabricDomain's declaration order (party index = mesh position =
+    ring position for the MSA6xx hop count).  The fabric transport's
+    permute programs (distributed/fabric.py) run ``lax.ppermute`` over
+    this axis."""
+    arr = np.array(list(devices))
+    if arr.ndim != 1 or arr.size < 2:
+        raise ValueError(
+            "fabric_party_mesh needs a flat list of >= 2 lead devices, "
+            f"got shape {arr.shape}"
+        )
+    return jax.sharding.Mesh(arr, ("parties",))
+
+
 def rep_sharding(mesh, batch_axis: Optional[int] = 0, ndim: int = 2):
     """PartitionSpec for a stacked share array (3, 2, *shape): party axis
     over 'parties', one data axis over 'data'."""

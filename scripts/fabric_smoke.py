@@ -1,0 +1,250 @@
+"""CI fabric transport smoke (ISSUE 19 acceptance): a 3-party logreg
+SGD training step runs over 3 in-process workers on 127.0.0.1 gRPC
+ports whose parties opt into ONE FabricDomain — every inter-party value
+moves as a collective permute over the shared (CPU virtual-device)
+mesh, the gRPC path staying as the trust-boundary fallback.
+
+Asserts:
+
+1. **zero gRPC sends intra-fabric**: across two fabric sessions (cold +
+   warm) the ``moose_tpu_net_sends_total{transport="grpc"}`` counter
+   never moves — every inter-party edge of the training step lowered
+   to a permute;
+2. **bit-identity**: the fabric epoch's revealed weights equal the
+   plain gRPC cluster's BIT-exactly under ``MOOSE_TPU_FIXED_KEYS`` (the
+   fabric moves the very tensors the wire would have serialized);
+3. **predicted == measured EXACTLY**: the warm session's fabric counter
+   deltas (permutes, batched permutes, permute payloads, device bytes,
+   singleton sends) equal the MSA6xx cost model's fabric prediction —
+   the analyzer can never silently drift from the runtime;
+4. **mixed sessions green**: with carole OUTSIDE the trust domain, the
+   session completes bit-identically, exactly the crossing edges fall
+   back to gRPC (``trust_boundary`` fallback tally equals the model's
+   per-party ``fallback_sends``), and the session report says
+   ``transport: mixed``;
+5. the session report carries ``transport``/``trust_model`` for bench
+   rows and postmortems.
+
+Prints one JSON summary line (the CI log artifact).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/fabric_smoke.py
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the fabric needs one lead device per party: 8 virtual CPU devices
+# unless the caller already forced a device count
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# replicated truncation noise is share-dependent: bit-exact
+# cross-CLUSTER comparisons need the session PRF keys pinned (a
+# testing knob — it voids inter-party secrecy, hence the explicit
+# weak-PRF acknowledgement; this smoke is one process anyway)
+os.environ.setdefault("MOOSE_TPU_FIXED_KEYS", "fabric-smoke")
+os.environ.setdefault("MOOSE_TPU_ALLOW_WEAK_PRF", "1")
+os.environ.setdefault("MOOSE_TPU_PRF", "threefry")
+# pin the worker to eager numerics: the jit plan ladder warms across
+# sessions (validating -> jit), and the two modes differ by one
+# fixed(14,23) LSB — bit-identity across clusters needs ONE mode.
+# Eager workers issue singleton sends, so predictions use
+# ``coalesce=False`` below.
+os.environ.setdefault("MOOSE_TPU_JIT", "0")
+
+IDENTITIES = ["alice", "bob", "carole"]
+
+
+def build_traced():
+    """One logreg SGD step (the ISSUE 19 acceptance workload: a
+    training epoch's worth of per-step cross-party traffic)."""
+    from moose_tpu.predictors.trainers import LogregSGDTrainer
+
+    trainer = LogregSGDTrainer(n_features=2, steps_per_epoch=1)
+    return trainer.step_computation(4)
+
+
+def run_session(traced, args, fabric_domain=None, session_tag=""):
+    """One client-supervised session over a fresh in-process gRPC
+    cluster; returns (outputs, last_session_report)."""
+    from moose_tpu.dialects import host as host_dialect
+    from moose_tpu.distributed.choreography import start_local_cluster
+    from moose_tpu.distributed.client import GrpcClientRuntime
+
+    servers, endpoints = start_local_cluster(
+        IDENTITIES, receive_timeout=30.0, startup_grace=10.0,
+        fabric_domain=fabric_domain,
+    )
+    try:
+        runtime = GrpcClientRuntime(endpoints, max_attempts=1)
+        # each compile draws fresh seed-derivation nonces, and
+        # replicated truncation noise is mask-dependent: bit-exact
+        # cross-CLUSTER comparisons need the same nonce sequence in
+        # every compilation
+        with host_dialect.deterministic_sync_keys(1234):
+            outputs, _ = runtime.run_computation(
+                traced, args, timeout=120.0
+            )
+        return outputs, runtime.last_session_report
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def metric(name, **labels):
+    from moose_tpu import metrics
+
+    return metrics.REGISTRY.value(name, **labels)
+
+
+def main() -> int:
+    from moose_tpu.compilation import DEFAULT_PASSES, compile_computation
+    from moose_tpu.compilation.analysis.cost import cost_report
+    from moose_tpu.compilation.lowering import arg_specs_from_arguments
+    from moose_tpu.distributed.fabric import FabricDomain
+
+    summary = {}
+    rng = np.random.default_rng(0)
+    args = {
+        "x": rng.normal(size=(4, 2)),
+        "y": (rng.random(size=(4, 1)) > 0.5).astype(np.float64),
+        "w": np.zeros((2, 1)),
+    }
+    traced = build_traced()
+
+    # ---- baseline: plain gRPC cluster --------------------------------
+    base_out, base_report = run_session(traced, args)
+    assert base_report["ok"], base_report
+    assert base_report["transport"] == "grpc", base_report["transport"]
+    summary["grpc_ok"] = True
+
+    # ---- one FabricDomain: cold + warm, zero gRPC intra-fabric -------
+    domain = FabricDomain.default(IDENTITIES, trust_model="simulation")
+    fabric_counters = {
+        "sends": lambda: metric(
+            "moose_tpu_net_sends_total", transport="fabric"
+        ),
+        "fabric_permutes": lambda: metric(
+            "moose_tpu_fabric_permutes_total"
+        ),
+        "fabric_batched_permutes": lambda: metric(
+            "moose_tpu_fabric_batched_permutes_total"
+        ),
+        "fabric_permute_payloads": lambda: metric(
+            "moose_tpu_fabric_permute_payloads_total"
+        ),
+        "fabric_tx_bytes": lambda: metric(
+            "moose_tpu_fabric_tx_bytes_total"
+        ),
+    }
+    grpc_before = metric("moose_tpu_net_sends_total", transport="grpc")
+    fab_out, fab_report = run_session(
+        traced, args, fabric_domain=domain
+    )  # cold: compiles every (edge, shape-set) permute program
+    before = {k: f() for k, f in fabric_counters.items()}
+    warm_out, warm_report = run_session(
+        traced, args, fabric_domain=domain
+    )
+    measured = {
+        k: int(f() - before[k]) for k, f in fabric_counters.items()
+    }
+    grpc_sends = int(
+        metric("moose_tpu_net_sends_total", transport="grpc")
+        - grpc_before
+    )
+    assert grpc_sends == 0, (
+        f"{grpc_sends} gRPC sends leaked out of the fabric"
+    )
+    assert measured["fabric_permutes"] > 0, measured
+    assert warm_report["transport"] == "fabric", warm_report
+    assert warm_report["trust_model"] == "simulation"
+    summary["grpc_sends_intra_fabric"] = grpc_sends
+    summary["warm_measured"] = measured
+
+    # bit-identity: fabric vs wire under pinned keys
+    for name in base_out:
+        if not np.array_equal(
+            np.asarray(base_out[name]), np.asarray(warm_out[name])
+        ):
+            raise AssertionError(
+                f"fabric output {name} diverged from the gRPC run"
+            )
+    summary["bit_identical"] = True
+
+    # predicted == measured EXACTLY (the workers compile the same
+    # computation bytes with the same passes: the client-side compile
+    # reproduces their rendezvous schedule)
+    compiled = compile_computation(
+        traced, DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+    session_id = warm_report["attempts"][-1]["session_id"]
+    report = cost_report(
+        compiled, session_id=session_id, transport="fabric",
+        fabric_parties=tuple(IDENTITIES), coalesce=False,
+    )
+    assert report["resolved"], report
+    predicted = {k: int(report["totals"][k]) for k in measured}
+    assert measured == predicted, (measured, predicted)
+    assert report["totals"]["fallback_sends"] == 0
+    summary["predicted"] = predicted
+    summary["exact_match"] = True
+
+    # ---- mixed session: carole outside the trust domain --------------
+    mixed_domain = FabricDomain.default(
+        ["alice", "bob"], trust_model="colocated_tee"
+    )
+    crossing_before = metric(
+        "moose_tpu_fabric_fallbacks_total", reason="trust_boundary"
+    )
+    mixed_out, mixed_report = run_session(
+        traced, args, fabric_domain=mixed_domain
+    )
+    crossed = int(
+        metric(
+            "moose_tpu_fabric_fallbacks_total", reason="trust_boundary"
+        )
+        - crossing_before
+    )
+    assert mixed_report["ok"], mixed_report
+    assert mixed_report["transport"] == "mixed", mixed_report
+    for name in base_out:
+        if not np.array_equal(
+            np.asarray(base_out[name]), np.asarray(mixed_out[name])
+        ):
+            raise AssertionError(
+                f"mixed output {name} diverged from the gRPC run"
+            )
+    mixed_cost = cost_report(
+        compiled,
+        session_id=mixed_report["attempts"][-1]["session_id"],
+        transport="fabric", fabric_parties=("alice", "bob"),
+        coalesce=False,
+    )
+    predicted_crossing = sum(
+        mixed_cost["per_party"][p]["fallback_sends"]
+        for p in ("alice", "bob")
+    )
+    assert crossed == predicted_crossing, (crossed, predicted_crossing)
+    summary["mixed_ok"] = True
+    summary["mixed_crossing_sends"] = crossed
+    summary["transports"] = mixed_report["transports"]
+
+    print(json.dumps({"fabric_smoke": summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1093,6 +1093,229 @@ def test_prancer_cli_schedule_and_cost_report(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# MSA505 fabric collective schedules + MSA6xx fabric pricing
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_schedule_clean_graph_passes_msa505():
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_fabric_schedules,
+        reconstruct_schedules,
+    )
+
+    comp = _networked_pair_graph()
+    diags = analyze_fabric_schedules(
+        comp, reconstruct_schedules(comp), frozenset({"alice", "bob"})
+    )
+    assert diags == [], diags
+
+
+def test_fabric_duplicate_intra_fabric_key_fires_msa505():
+    """Two intra-fabric Sends racing into one rendezvous cell: the
+    wire drops the duplicate frame, a second collective permute is a
+    silent payload loss — the fabric refuses."""
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_fabric_schedules,
+        reconstruct_schedules,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = _networked_pair_graph()
+    comp.add_operation(Operation(
+        "s2", "Send", ["m"], "alice", Signature((ring,), UnitTy),
+        {"rendezvous_key": "k-0", "receiver": "bob"},
+    ))
+    schedules = reconstruct_schedules(comp)
+    diags = analyze_fabric_schedules(
+        comp, schedules, frozenset({"alice", "bob"})
+    )
+    msa505 = [d for d in diags if d.rule == "MSA505"]
+    assert msa505, diags
+    assert any("intra-fabric" in d.message for d in msa505)
+    assert all(d.severity is Severity.ERROR for d in msa505)
+    # ... but when the receiver sits OUTSIDE the fabric the edge rides
+    # the wire and its dup-frame semantics: no fabric finding
+    assert analyze_fabric_schedules(
+        comp, schedules, frozenset({"alice", "carole"})
+    ) == []
+
+
+def test_fabric_wait_cycle_fires_msa505():
+    """Rule 1 re-codes the MSA501 fixed point: a schedule the wire
+    would already hang on is certainly not fabric-safe."""
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_fabric_schedules,
+        build_role_schedule,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    for role, send_key, recv_key in (
+        ("alice", "k-ab", "k-ba"), ("bob", "k-ba", "k-ab"),
+    ):
+        comp.add_operation(Operation(
+            f"c_{role}", "Constant", [], role, Signature((), ring),
+            {"value": np.zeros((2,))},
+        ))
+        comp.add_operation(Operation(
+            f"r_{role}", "Receive", [], role, Signature((), ring),
+            {"rendezvous_key": recv_key, "sender": "x"},
+        ))
+        comp.add_operation(Operation(
+            f"s_{role}", "Send", [f"c_{role}"], role,
+            Signature((ring,), UnitTy),
+            {"rendezvous_key": send_key, "receiver": "x"},
+        ))
+    schedules = {
+        role: build_role_schedule(
+            comp, role, order=[f"c_{role}", f"r_{role}", f"s_{role}"],
+        )
+        for role in ("alice", "bob")
+    }
+    diags = analyze_fabric_schedules(
+        comp, schedules, frozenset({"alice", "bob"})
+    )
+    msa505 = [d for d in diags if d.rule == "MSA505"]
+    assert msa505, diags
+    assert any("wait graph" in d.message for d in msa505)
+
+
+def test_fabric_inverted_flush_order_fires_msa505():
+    """The fabric-specific deadlock the wire analysis is blind to: the
+    wire would buffer both frames so the wait-graph fixed point HOLDS,
+    but on one ordered collective channel the receiver waiting k-1
+    before k-0 against a sender flushing k-0 before k-1 is an
+    issue-order deadlock — the hand-built schedule the by-construction
+    reconstruction could never produce."""
+    from moose_tpu.compilation.analysis.schedule import (
+        analyze_fabric_schedules,
+        analyze_schedules,
+        build_role_schedule,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing128Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob")
+    comp.add_operation(Operation(
+        "c", "Constant", [], "alice", Signature((), ring),
+        {"value": np.zeros((2,))},
+    ))
+    for i in range(2):
+        comp.add_operation(Operation(
+            f"s{i}", "Send", ["c"], "alice", Signature((ring,), UnitTy),
+            {"rendezvous_key": f"k-{i}", "receiver": "bob"},
+        ))
+        comp.add_operation(Operation(
+            f"r{i}", "Receive", [], "bob", Signature((), ring),
+            {"rendezvous_key": f"k-{i}", "sender": "alice"},
+        ))
+    comp.add_operation(Operation(
+        "use", "Mul", ["r0", "r1"], "bob",
+        Signature((ring, ring), ring),
+    ))
+    comp.add_operation(Operation(
+        "out", "Output", ["use"], "bob", Signature((ring,), ring),
+    ))
+    schedules = {
+        "alice": build_role_schedule(
+            comp, "alice", order=["c", "s0", "s1"],
+        ),
+        "bob": build_role_schedule(
+            comp, "bob", order=["r1", "r0", "use", "out"],
+        ),
+    }
+    # the wire is satisfied with this schedule ...
+    assert not [
+        d for d in analyze_schedules(comp, schedules)
+        if d.severity >= Severity.ERROR
+    ]
+    # ... the fabric refuses it
+    diags = analyze_fabric_schedules(
+        comp, schedules, frozenset({"alice", "bob"})
+    )
+    msa505 = [d for d in diags if d.rule == "MSA505"]
+    assert len(msa505) == 1, diags  # one inversion per edge suffices
+    assert "issue-order deadlock" in msa505[0].message
+    assert msa505[0].placement == "bob"
+    # a receiver honouring the flush order is clean
+    schedules["bob"] = build_role_schedule(
+        comp, "bob", order=["r0", "r1", "use", "out"],
+    )
+    assert analyze_fabric_schedules(
+        comp, schedules, frozenset({"alice", "bob"})
+    ) == []
+
+
+def test_fabric_cost_report_prices_permutes_and_crossing_edges():
+    """MSA6xx fabric pricing: an intra-fabric edge is device bytes x
+    ring hops with NO wire framing; a crossing edge keeps the exact
+    gRPC frame price and is tallied as a fallback send."""
+    from moose_tpu.compilation.analysis import cost_report
+    from moose_tpu.compilation.analysis.cost import (
+        fabric_hops,
+        fabric_payload,
+        infer_specs,
+    )
+    from moose_tpu.computation import Ty
+
+    ring = Ty("HostRing64Tensor")
+    comp = Computation()
+    _hosts(comp, "alice", "bob", "carole")
+    comp.add_operation(Operation(
+        "c", "Constant", [], "alice", Signature((), ring),
+        {"value": np.zeros((4, 3))},
+    ))
+    for i, receiver in enumerate(("bob", "carole")):
+        comp.add_operation(Operation(
+            f"s{i}", "Send", ["c"], "alice", Signature((ring,), UnitTy),
+            {"rendezvous_key": f"k-{i}", "receiver": receiver},
+        ))
+        comp.add_operation(Operation(
+            f"r{i}", "Receive", [], receiver, Signature((), ring),
+            {"rendezvous_key": f"k-{i}", "sender": "alice"},
+        ))
+    comp.add_operation(Operation(
+        "out0", "Output", ["r0"], "bob", Signature((ring,), ring),
+    ))
+    comp.add_operation(Operation(
+        "out1", "Output", ["r1"], "carole", Signature((ring,), ring),
+    ))
+
+    specs = infer_specs(comp)
+    # the fabric payload is DEVICE bytes (96 for a 4x3 ring64 lo
+    # plane), not the serialized frame
+    assert fabric_payload(specs["c"]) == (1, 96)
+    assert fabric_hops(("alice", "bob"), "alice", "bob") == 1
+
+    report = cost_report(
+        comp, transport="fabric", fabric_parties=("alice", "bob"),
+    )
+    assert report["resolved"], report
+    totals = report["totals"]
+    assert totals["fabric_permutes"] == 1
+    assert totals["fabric_permute_payloads"] == 1
+    assert totals["fabric_batched_permutes"] == 0
+    assert totals["fabric_tx_bytes"] == 96
+    assert totals["fabric_cost"] == 96  # 96 bytes x 1 hop
+    assert totals["fallback_sends"] == 1  # alice -> carole
+    # the crossing edge keeps wire framing: total egress exceeds the
+    # two raw payloads
+    assert totals["tx_bytes"] > 2 * 96
+    assert report["per_party"]["bob"]["rx_bytes"] == 96
+    assert report["per_party"]["carole"]["rx_bytes"] > 96
+    assert report["fabric_parties"] == ["alice", "bob"]
+    # transport="fabric" with no explicit member list: every party of
+    # the plan is in the one domain
+    assert cost_report(comp, transport="fabric")["fabric_parties"] == [
+        "alice", "bob", "carole",
+    ]
+
+
+# ---------------------------------------------------------------------------
 # MSA7xx fixed-point value ranges + MSA105 storage secrecy (ISSUE 15)
 # ---------------------------------------------------------------------------
 

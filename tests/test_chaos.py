@@ -99,7 +99,7 @@ def _stop_cluster(servers):
 
 
 def _run_cluster_once(chaos=None, max_attempts=3, receive_timeout=2.5,
-                      timeout=30.0):
+                      timeout=30.0, fabric_domain=None):
     """One full GrpcClientRuntime run of the 3-party secure dot under an
     optional chaos schedule; returns (outputs, report)."""
     from moose_tpu.distributed.client import GrpcClientRuntime
@@ -108,6 +108,7 @@ def _run_cluster_once(chaos=None, max_attempts=3, receive_timeout=2.5,
         ["alice", "bob", "carole"],
         ping_interval=0.25, ping_misses=3, startup_grace=5.0,
         receive_timeout=receive_timeout, stall_grace=0.5, chaos=chaos,
+        fabric_domain=fabric_domain,
     )
     try:
         runtime = GrpcClientRuntime(
@@ -728,3 +729,62 @@ def test_chaos_env_parses_max_kills():
     assert cfg.kill_after_ops == 5 and cfg.max_kills == 3
     # default preserves the classic kill-once schedule
     assert ChaosConfig.from_env("seed:1,kill_after_ops:5").max_kills == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos over the fabric transport
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_drop_over_fabric_replays_on_wire_bit_exact(monkeypatch):
+    """Chaos composes OVER the fabric: fault decisions key on the
+    stable logical rendezvous key before any permute lowering, a
+    dropped key's replay is latched onto the gRPC path (the collective
+    whose payload was lost is never re-entered), and the SAME seed
+    produces the identical fault schedule and bit-exact outputs with
+    the fabric on or off."""
+    monkeypatch.setenv("MOOSE_TPU_FIXED_KEYS", "chaos-fabric")
+    from moose_tpu import metrics as metrics_mod
+    from moose_tpu.distributed.fabric import FabricDomain
+
+    domain = FabricDomain.default(
+        ["alice", "bob", "carole"], trust_model="simulation"
+    )
+    before_forced = metrics_mod.REGISTRY.value(
+        "moose_tpu_fabric_fallbacks_total", reason="forced_wire"
+    )
+    chaos1 = ChaosConfig(seed=DROP_SEED, drop_send=0.2)
+    out1, rep1 = _run_cluster_once(
+        chaos=chaos1, fabric_domain=domain, receive_timeout=10.0,
+        timeout=90.0,
+    )
+    drops1 = [f for f in chaos1.faults if f["kind"] == "drop_send"]
+    assert drops1, "seed must drop at least one first-attempt send"
+    assert rep1["ok"] is True and rep1["retried"] is True
+    # the session report says what the traffic rode on
+    assert rep1["transport"] == "fabric"
+    assert rep1["trust_model"] == "simulation"
+    assert set(rep1["transports"]) == {"alice", "bob", "carole"}
+    # the dropped keys' replays were latched onto the wire path
+    forced = metrics_mod.REGISTRY.value(
+        "moose_tpu_fabric_fallbacks_total", reason="forced_wire"
+    ) - before_forced
+    assert forced > 0
+
+    # fabric OFF, same seed: identical fault schedule (fault records
+    # carry no transport field), bit-exact outputs
+    chaos2 = ChaosConfig(seed=DROP_SEED, drop_send=0.2)
+    out2, rep2 = _run_cluster_once(chaos=chaos2)
+    assert chaos1.schedule_digest(kinds={"drop_send"}) == \
+        chaos2.schedule_digest(kinds={"drop_send"})
+    assert sorted(
+        f["key"] for f in drops1
+    ) == sorted(
+        f["key"] for f in chaos2.faults if f["kind"] == "drop_send"
+    )
+    assert rep2["transport"] == "grpc"
+    assert set(out1) == set(out2)
+    for name in out1:
+        np.testing.assert_array_equal(
+            np.asarray(out1[name]), np.asarray(out2[name])
+        )

@@ -1226,3 +1226,115 @@ def test_cost_model_matches_measured_counters_exactly(monkeypatch):
         assert sum(
             report["per_party"][p][key] for p in report["per_party"]
         ) == predicted[key]
+
+
+@pytest.mark.slow
+def test_fabric_logreg_warm_counters_match_cost_model_exactly(
+    monkeypatch,
+):
+    """The fabric acceptance pin: a WARM (second-session) 3-party
+    logreg SGD run inside one FabricDomain moves ZERO payloads over the
+    wire transport, and every fabric counter delta — permutes, batched
+    permutes, permute payloads, device bytes, singleton sends — equals
+    the MSA6xx cost model's fabric prediction EXACTLY.  Worker jit is
+    ON so coalesced flush groups lower to batched permutes (the eager
+    singleton path is pinned by test_fabric.py)."""
+    monkeypatch.setenv("MOOSE_TPU_JIT", "1")
+    monkeypatch.setenv("MOOSE_TPU_FIXED_KEYS", "fabric-logreg")
+    from moose_tpu import metrics
+    from moose_tpu.compilation.analysis.cost import cost_report
+    from moose_tpu.distributed.fabric import (
+        FabricDomain,
+        FabricNetworking,
+    )
+    from moose_tpu.predictors.trainers import LogregSGDTrainer
+
+    trainer = LogregSGDTrainer(n_features=2, steps_per_epoch=1)
+    rng = np.random.default_rng(7)
+    args = {
+        "x": rng.normal(size=(4, 2)),
+        "y": (rng.random(size=(4, 1)) > 0.5).astype(np.float64),
+        "w": np.zeros((2, 1)),
+    }
+    compiled = compile_computation(
+        trainer.step_computation(4), DEFAULT_PASSES,
+        arg_specs=arg_specs_from_arguments(args),
+    )
+
+    identities = ["alice", "bob", "carole"]
+    domain = FabricDomain.default(identities, trust_model="simulation")
+    inner = LocalNetworking()
+    nets = {
+        i: FabricNetworking(domain, i, inner) for i in identities
+    }
+
+    def run(session_id):
+        results, errors = {}, {}
+
+        def work(identity):
+            try:
+                results[identity] = execute_role(
+                    compiled, identity, {}, args, nets[identity],
+                    session_id=session_id, timeout=120.0,
+                )
+            except Exception as e:  # pragma: no cover
+                errors[identity] = e
+
+        threads = [
+            threading.Thread(target=work, args=(i,), daemon=True)
+            for i in identities
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert not errors, errors
+        return {
+            k: np.asarray(v)
+            for r in results.values() for k, v in r["outputs"].items()
+        }
+
+    run("fab-lr-cold")  # jits every (edge, shape-set) permute program
+
+    names = {
+        "fabric_permutes": "moose_tpu_fabric_permutes_total",
+        "fabric_batched_permutes":
+            "moose_tpu_fabric_batched_permutes_total",
+        "fabric_permute_payloads":
+            "moose_tpu_fabric_permute_payloads_total",
+        "fabric_tx_bytes": "moose_tpu_fabric_tx_bytes_total",
+    }
+
+    def snap():
+        out = {k: metrics.REGISTRY.value(v) for k, v in names.items()}
+        out["sends"] = metrics.REGISTRY.value(
+            "moose_tpu_net_sends_total", transport="fabric"
+        )
+        out["wire"] = metrics.REGISTRY.value(
+            "moose_tpu_net_sends_total", transport="local"
+        )
+        return out
+
+    before = snap()
+    out_warm = run("fab-lr-warm")
+    after = snap()
+    measured = {k: int(after[k] - before[k]) for k in names}
+    measured["sends"] = int(after["sends"] - before["sends"])
+
+    # zero wire sends on intra-fabric edges
+    assert after["wire"] == before["wire"]
+    # warm weights well-formed (one revealed (2, 1) update at bob)
+    (w_out,) = out_warm.values()
+    assert w_out.shape == (2, 1) and np.isfinite(w_out).all()
+
+    report = cost_report(
+        compiled, session_id="fab-lr-warm", transport="fabric",
+        fabric_parties=tuple(identities),
+    )
+    assert report["resolved"], report
+    predicted = {
+        k: int(report["totals"][k]) for k in list(names) + ["sends"]
+    }
+    assert measured == predicted
+    assert report["totals"]["fallback_sends"] == 0
+    assert report["totals"]["fabric_batched_permutes"] > 0
