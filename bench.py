@@ -250,6 +250,26 @@ def bench_pallas_kernels(iters=5):
             finally:
                 rk.set_enabled(None)
         out[name] = entry
+    # dot_cross_terms A/B at the autotuner's canonical shape classes
+    # (ISSUE 20): measure_dot_micro records the SAME rows the
+    # trace-time dispatch policy consumes, so the bench record and the
+    # in-process plan decisions come from one measurement.  The
+    # decision table shows where the autotuner flips the MXU kernel on
+    # (expected: mxu/tall yes on TPU, small stays limb_int8 XLA).
+    from moose_tpu.compilation import autotune
+
+    for width in (128, 64):
+        for cls, shape in autotune._DOT_CLASS_SHAPES.items():
+            try:
+                row = autotune.measure_dot_micro(width, cls, iters=iters)
+            except Exception as e:  # noqa: BLE001 — report as data
+                row = {"error": f"{type(e).__name__}: {e}"}
+            out[f"dot_ring{width}_{cls}"] = row or {
+                "error": "shape unsupported or timing failed"
+            }
+            # fold the fresh row into the dispatch decision table
+            autotune.dot_kernel_wanted(width, shape)
+    out["dot_autotune_decisions"] = autotune.dot_decision_table()
     # which kernels the pallas legs ACTUALLY ran (vs fell back)
     out["kernel_verdicts"] = _pallas_report()["kernels"]
     return out
@@ -545,6 +565,11 @@ def _bench_predictor(comp, args, check, batch, layout=None, iters=5,
         "pinned_ops": list(runtime.last_plan.get("pinned_ops", ())),
         "layout": runtime.last_plan.get("layout"),
         "window_medians": medians,
+        # ISSUE 20: the resolved autotune decision table for this
+        # computation (knob -> {choice, source, why} + the per-class
+        # pallas-dot verdicts) so every benched computation records
+        # WHICH plan the numbers were measured under
+        "autotune": runtime.last_plan.get("autotune"),
     }
     return batch / latency, latency, info
 
@@ -1548,9 +1573,13 @@ def main():
     # 100 features, fixed(24,40)) via from_onnx + LocalMooseRuntime
     try:
         if _within_budget():
-            infer_per_sec, infer_latency, _ = bench_logreg_inference()
+            infer_per_sec, infer_latency, lr_info = bench_logreg_inference()
             record["logreg_infer_per_sec"] = infer_per_sec
             record["logreg_infer_batch128_latency_s"] = infer_latency
+            # ISSUE 20: decision table of the plan these numbers were
+            # measured under (autotuned segment limit, pallas-dot
+            # class verdicts, ...)
+            record["logreg_autotune"] = lr_info.get("autotune")
         else:  # cold caches ate the budget; keep the headline on time
             print("# logreg inference bench skipped (budget)")
     except Exception as e:  # the headline metric must still print
@@ -1667,9 +1696,9 @@ def main():
         print(f"# logreg batch-1024 bench failed: {e}")
     try:
         if _within_budget():
-            record["mlp_infer_batch1024_per_sec"], _, _ = (
-                bench_mlp_inference(batch=1024)
-            )
+            mlp_per_sec, _, mlp_info = bench_mlp_inference(batch=1024)
+            record["mlp_infer_batch1024_per_sec"] = mlp_per_sec
+            record["mlp_autotune"] = mlp_info.get("autotune")
     except Exception as e:
         print(f"# mlp batch-1024 bench failed: {e}")
     emit()
@@ -1704,6 +1733,9 @@ def main():
                 plan_info.get("pinned_ops") or ()
             )
             record["stacked_userpath_layout"] = plan_info.get("layout")
+            record["stacked_userpath_autotune"] = plan_info.get(
+                "autotune"
+            )
             per_sec_h, lat_h = bench_logreg_handwritten()
             record["logreg_infer_per_sec_handwritten"] = per_sec_h
             emit()

@@ -89,6 +89,7 @@ __all__ = [
     "plan_errors",
     "reconstruct_schedules",
     "worker_min_seg",
+    "worker_min_seg_decision",
 ]
 
 # One plan step: ("op", op_name) — a host-boundary op the orchestrator
@@ -376,16 +377,35 @@ def reconstruct_schedules(
     gate asks again per session, and the walk is O(ops) pure Python —
     pay it once per graph."""
     resolved_limit = _segment_limit() if limit is None else limit
-    resolved_min = worker_min_seg() if min_seg is None else min_seg
     if roles is not None:
+        resolved = worker_min_seg() if min_seg is None else min_seg
         order = comp.toposort_names()
         return {
             role: build_role_schedule(
                 comp, role, order=order, limit=resolved_limit,
-                min_seg=resolved_min, max_deferred=max_deferred,
+                min_seg=resolved, max_deferred=max_deferred,
             )
             for role in roles
         }
+    if min_seg is None:
+        # default resolution is autotune-aware, TWO-PASS: build at the
+        # env floor, decide from the segment histogram, rebuild only if
+        # the floor lifts.  Resolving here (not in the worker) keeps the
+        # MSA5xx analyzer, the MSA6xx cost model, fabric and prancer on
+        # the SAME schedule the worker runs — predictions cannot drift.
+        # Both passes hit the explicit-min_seg memo below.
+        base = reconstruct_schedules(
+            comp, limit=limit, min_seg=worker_min_seg(),
+            max_deferred=max_deferred,
+        )
+        decision = worker_min_seg_decision(comp, base)
+        if decision.choice == worker_min_seg():
+            return base
+        return reconstruct_schedules(
+            comp, limit=limit, min_seg=decision.choice,
+            max_deferred=max_deferred,
+        )
+    resolved_min = min_seg
     knobs = (resolved_limit, resolved_min, max_deferred)
     per_comp = _reconstruct_cache.get(comp)
     if per_comp is not None and knobs in per_comp:
@@ -405,6 +425,26 @@ def reconstruct_schedules(
         per_comp = _reconstruct_cache[comp] = {}
     per_comp[knobs] = schedules
     return schedules
+
+
+def worker_min_seg_decision(comp: Computation, base=None):
+    """The autotuned worker eager-floor decision for ``comp`` (a
+    :class:`~moose_tpu.compilation.autotune.Decision`): env override >
+    segment-histogram heuristic > default.  ``base`` may carry the
+    env-floor schedules to decide from (avoids a rebuild); without it
+    they come from the memoized reconstruction.  Deterministic given
+    (computation, env) — every process resolves the same floor, so
+    chaos seed replays stay bit-identical."""
+    from .. import autotune
+
+    if base is None:
+        base = reconstruct_schedules(comp, min_seg=worker_min_seg())
+    sizes = [
+        len(seg.names)
+        for sched in base.values()
+        for seg in sched.segments
+    ]
+    return autotune.worker_min_seg_for(sizes)
 
 
 def _analyzable(comp: Computation) -> bool:

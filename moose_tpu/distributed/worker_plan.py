@@ -566,9 +566,22 @@ class RolePlan:
 
         # the statically-checkable schedule skeleton — segmentation,
         # hoisting, deferral, flush grouping — comes from the SAME
-        # function the MSA5xx analyzer reconstructs plans with, so the
-        # plan the analyzer approved is byte-for-byte the plan that runs
-        schedule = build_role_schedule(comp, identity)
+        # reconstruction the MSA5xx analyzer and MSA6xx cost model use
+        # (including the autotuned eager floor: the two-pass min_seg
+        # resolution lives in reconstruct_schedules, so the plan the
+        # analyzer approved and the wire costs the watchdog predicts
+        # are byte-for-byte the plan that runs)
+        from ..compilation.analysis.schedule import (
+            reconstruct_schedules,
+            worker_min_seg_decision,
+        )
+
+        self.autotune_min_seg = worker_min_seg_decision(comp)
+        schedule = reconstruct_schedules(comp).get(identity)
+        if schedule is None:  # role with no ops of its own
+            schedule = build_role_schedule(
+                comp, identity, min_seg=self.autotune_min_seg.choice
+            )
         self.schedule = schedule
         self.segments = [
             _Segment(
@@ -759,6 +772,8 @@ def get_plan(comp, identity: str,
         steps=len(plan.steps), receives=len(plan.recv_names),
         min_ring_width=plan.ranges_advisory.get("min_ring_width"),
         peak_raw_bits=plan.ranges_advisory.get("peak_raw_bits"),
+        min_seg=plan.autotune_min_seg.choice,
+        min_seg_source=plan.autotune_min_seg.source,
     )
     return plan
 

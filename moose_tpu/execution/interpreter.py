@@ -907,8 +907,14 @@ class _SelfCheckRunner(_SelfCheckBase):
 
     def __init__(self, comp, arguments, checks: int, dialect=None,
                  builder=None, pin_nonces: bool = True,
-                 per_op_builder=None, plan_key: Optional[str] = None):
+                 per_op_builder=None, plan_key: Optional[str] = None,
+                 segment_limit: Optional[int] = None):
         import weakref
+
+        # autotuned segment limit: substitutes ONLY the ladder's first
+        # (None = env default) rung — demotion rungs (200 / 50 / per-op)
+        # and the exactness discipline are untouched
+        self._tuned_limit = segment_limit
 
         # weak: the runner is cached in a weak-keyed dict keyed by the
         # computation — a strong capture would keep the entry alive
@@ -1033,6 +1039,8 @@ class _SelfCheckRunner(_SelfCheckBase):
         if comp is None:  # pragma: no cover - defensive
             raise RuntimeError("computation was garbage-collected")
         limit = self.LADDER[self._level]
+        if limit is None:
+            limit = self._tuned_limit  # autotuned first rung (or None)
         if limit is _PER_OP:
             self._jit_fn = None
             self._ref_fn = None
@@ -1109,6 +1117,8 @@ class _SelfCheckRunner(_SelfCheckBase):
         limit = self.LADDER[self._level]
         if limit is _PER_OP:
             return _PER_OP
+        if limit is None:
+            limit = self._tuned_limit
         seg = limit if limit is not None else _segment_limit()
         return "segmented" if len(self._order) > seg else "whole-graph"
 
@@ -1614,16 +1624,29 @@ class Interpreter:
         cache_key = self._cache_key(arguments, (use_jit, selfcheck))
         cached = per_comp.get(cache_key)
         if cached is None:
+            from ..compilation import autotune as _autotune
+
+            tuned = _autotune.autotune_plan(comp, est_ops=n_ops)
+            seg_dec = tuned["segment_limit"]
+            # an env override already flows through _segment_limit();
+            # only a measured/predicted choice needs explicit threading
+            tuned_limit = (
+                seg_dec.choice
+                if seg_dec.source in ("predicted", "measured")
+                else None
+            )
             with telemetry.span("build_plan", n_ops=len(comp.operations)):
                 if selfcheck:
                     runner = _SelfCheckRunner(
                         comp, arguments, _selfcheck_runs(),
                         dialect=self._dialect, plan_key=self._plan_key,
+                        segment_limit=tuned_limit,
                     )
                     plan, fn = runner.eager_plan, runner.run
                 else:
                     plan = build_plan(
-                        comp, arguments, use_jit, dialect=self._dialect
+                        comp, arguments, use_jit,
+                        segment_limit=tuned_limit, dialect=self._dialect,
                     )
                     if plan.fn is not None:  # segmented: already jitted
                         fn = plan.fn
@@ -1631,9 +1654,9 @@ class Interpreter:
                         fn = (
                             jax.jit(plan.core) if plan.use_jit else plan.core
                         )
-            per_comp[cache_key] = (plan, fn)
+            per_comp[cache_key] = (plan, fn, tuned)
         else:
-            plan, fn = cached
+            plan, fn, tuned = cached
 
         dyn = {}
         with telemetry.span("bind_arguments"):
@@ -1698,6 +1721,16 @@ class Interpreter:
             # plan shape AFTER the run: a validating evaluation may have
             # promoted/demoted/pinned during the call
             info = self._plan_info(plan, fn)
+            if tuned is not None:
+                from ..compilation import autotune as _autotune
+
+                info["autotune"] = {
+                    "decisions": tuned.as_dict(),
+                    # per-(width, class) dot verdicts the trace-time
+                    # dispatch actually made (logical signatures carry
+                    # no static shapes to predict from)
+                    "pallas_dot_classes": _autotune.dot_decision_table(),
+                }
             self.last_plan_info = info
             sp.attrs["plan_mode"] = info["plan_mode"]
             sp.attrs["pinned_ops"] = len(info["pinned_ops"])

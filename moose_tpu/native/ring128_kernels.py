@@ -121,9 +121,12 @@ def enabled() -> bool:
 
 
 def dot_enabled() -> bool:
-    """The dot kernel is opt-in on top of the family knob (see module
-    docstring: the int8 MXU path already jits exactly and wins on
-    predictor shapes)."""
+    """The env-only view of the dot opt-in (the absolute knob:
+    ``MOOSE_TPU_PALLAS_DOT=1`` forces the kernel wherever the family is
+    on).  The dispatch gate itself is shape-aware: with the knob unset
+    it asks the autotuner's measured per-shape-class policy
+    (``compilation.autotune.dot_kernel_wanted``) — predictor-small
+    shapes keep limb_int8, measured-faster MXU shapes get the kernel."""
     return enabled() and os.environ.get("MOOSE_TPU_PALLAS_DOT") == "1"
 
 
@@ -181,20 +184,34 @@ def record_fallback(kernel: str, width: int, reason: str,
     )
 
 
-def dispatch(kernel: str, width: int) -> bool:
+def dispatch(kernel: str, width: int, shape=None) -> bool:
     """True when ``kernel`` should run at ``width``: knob on, width
     supported, and the first-use bit-exactness self-check against the
     lax twin passed.  A failed check records a permanent per-process
     fallback; a pass is cached.  The check runs EAGERLY on canned
     shapes (it needs concrete values to compare), so calling this from
-    inside a jit trace is safe — the verdict is a Python bool."""
+    inside a jit trace is safe — the verdict is a Python bool.
+
+    ``shape`` (``(m, k, n)``, dot only) routes the decision through the
+    autotuner's measured per-shape-class policy when the absolute knob
+    ``MOOSE_TPU_PALLAS_DOT`` is unset: classes where the A/B micro
+    measured the MXU kernel faster than limb_int8 XLA turn it on; the
+    rest — and every call without a shape — keep the XLA path."""
     if width not in (64, 128):
         return False
     if getattr(_IN_CHECK, "active", False):
         return False  # a self-check's lax twin must stay pure lax
     if kernel == "dot_cross_terms":
-        if not dot_enabled():
+        if not enabled():
             return False
+        env = os.environ.get("MOOSE_TPU_PALLAS_DOT")
+        if env == "0":
+            return False
+        if env != "1":
+            from ..compilation import autotune
+
+            if not autotune.dot_kernel_wanted(width, shape):
+                return False
     elif not enabled():
         return False
     key = (kernel, width)
@@ -977,66 +994,126 @@ def _dot_body(x0_ref, x1_ref, y0_ref, ys_ref, o_ref, *, width):
         o_ref[i, 0] = plane
 
 
-def dot_cross_terms(x0, x1, y0, ysum, width: int):
+def _dot_tile_plan(m: int, k: int, n: int, width: int):
+    """Deterministic tile/segment search for the dot kernel: returns
+    ``(bm, bn, kseg)`` — m/n block sizes and the host-side contraction
+    segment length.  Preference order: fewest k segments (each segment
+    is a separate pallas call accumulated with a ring add), then the
+    largest ``bm``, then ``bn`` that fit the VMEM budget.  The per-call
+    contraction is bounded by the u32 diagonal exactness limit
+    ``(255 // in8) * _DOT_CHUNK``.  Raises :class:`ShapeUnsupported`
+    only when nothing fits (degenerate dims)."""
+    if m <= 0 or k <= 0 or n <= 0:
+        raise ShapeUnsupported(f"degenerate dot shape ({m},{k},{n})")
+    L = _n_planes(width)
+    in8 = width // 8
+    max_k = (255 // in8) * _DOT_CHUNK
+    mp, np_ = -(-m // 8) * 8, -(-n // 128) * 128
+
+    def ladder(top, steps):
+        out = [top]
+        out.extend(s for s in steps if s < top)
+        return out
+
+    bms = ladder(mp, (512, 256, 128, 64, 32, 16, 8))
+    bns = ladder(np_, (512, 256, 128))
+    for segs in range(1, -(-k // 128) + 1):
+        kseg = -(-k // segs)
+        if kseg > max_k:
+            continue
+        kp = -(-kseg // 128) * 128
+        for bm in bms:
+            for bn in bns:
+                if (
+                    4 * L * (2 * bm * kp + 2 * kp * bn + bm * bn)
+                    <= _DOT_VMEM_BUDGET
+                ):
+                    return bm, bn, kseg
+    raise ShapeUnsupported(
+        f"no dot tiling fits VMEM for ({m},{k},{n}) ring{width}"
+    )
+
+
+def dot_cross_terms(x0, x1, y0, ysum, width: int, *, tile_plan=None):
     """Fused party-batched matmul cross terms
     v_p = x0_p @ (y0+y1)_p + x1_p @ y0_p over 8-bit limbs on f32 MXU
     dots (exact: products < 2^16, 256-term chunks < 2^24, u32 diagonal
     accumulation).  ``ysum`` is precomputed by the caller (one cheap
     ring add).  Arguments are (lo, hi) pairs shaped (3, m, k) /
-    (3, k, n); raises :class:`ShapeUnsupported` outside the exactness /
-    VMEM bounds."""
+    (3, k, n).
+
+    MXU-shaped work is tiled: the grid runs (party, m-tiles, n-tiles)
+    with per-tile operands in VMEM, and contractions past the u32
+    exactness / VMEM bound are split into k segments on the host — dot
+    distributes over ring addition mod 2^w, so per-segment partials
+    accumulate exactly with a ring add.  ``tile_plan`` overrides the
+    deterministic search (tests force multi-tile grids on small
+    shapes).  Raises :class:`ShapeUnsupported` only for degenerate
+    shapes."""
+    from ..dialects import ring
+
     a_lo = x0[0]
     if a_lo.ndim != 3 or y0[0].ndim != 3:
         raise ShapeUnsupported("dot kernel needs (3, m, k) @ (3, k, n)")
     _, m, k = a_lo.shape
     n = y0[0].shape[-1]
-    in8 = width // 8
-    if -(-k // _DOT_CHUNK) * in8 > 255:
-        raise ShapeUnsupported(f"contraction k={k} exceeds the u32 bound")
     L = _n_planes(width)
-    mp, kp, np_ = -(-m // 8) * 8, -(-k // 128) * 128, -(-n // 128) * 128
-    if 4 * L * (2 * mp * kp + 2 * kp * np_ + mp * np_) > _DOT_VMEM_BUDGET:
-        raise ShapeUnsupported("operands exceed the VMEM budget")
+    bm, bn, kseg = (
+        tile_plan if tile_plan is not None
+        else _dot_tile_plan(m, k, n, width)
+    )
+    kp = -(-kseg // 128) * 128
+    mt, nt = -(-m // bm), -(-n // bn)
+    mp, np_ = mt * bm, nt * bn
 
-    def prep(v, rows, cols_, r_pad, c_pad):
-        planes = _planes_keep(v[0], v[1], 3).reshape(-1, 3, rows, cols_)
+    def prep(lo, hi, rows, cols_, r_pad, c_pad):
+        planes = _planes_keep(lo, hi, 3).reshape(-1, 3, rows, cols_)
         return jnp.pad(
             planes,
             ((0, 0), (0, 0), (0, r_pad - rows), (0, c_pad - cols_)),
         )
 
-    ins = [
-        prep(x0, m, k, mp, kp), prep(x1, m, k, mp, kp),
-        prep(y0, k, n, kp, np_), prep(ysum, k, n, kp, np_),
-    ]
+    def slice_x(v, c0, c1):
+        hi = None if v[1] is None else v[1][:, :, c0:c1]
+        return prep(v[0][:, :, c0:c1], hi, m, c1 - c0, mp, kp)
 
-    def spec(rows, cols_):
+    def slice_y(v, c0, c1):
+        hi = None if v[1] is None else v[1][:, c0:c1, :]
+        return prep(v[0][:, c0:c1, :], hi, c1 - c0, n, kp, np_)
+
+    def spec(rows, cols_, index):
         return pl.BlockSpec(
-            (L, 1, rows, cols_),
-            lambda p: (0, p, 0, 0),
-            memory_space=pltpu.VMEM,
+            (L, 1, rows, cols_), index, memory_space=pltpu.VMEM,
         )
 
-    out_shape = jax.ShapeDtypeStruct((L, 3, mp, np_), U32)
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(_dot_body, width=width),
-        grid=(3,),
+        grid=(3, mt, nt),
         in_specs=[
-            spec(mp, kp), spec(mp, kp), spec(kp, np_), spec(kp, np_),
+            spec(bm, kp, lambda p, i, j: (0, p, i, 0)),
+            spec(bm, kp, lambda p, i, j: (0, p, i, 0)),
+            spec(kp, bn, lambda p, i, j: (0, p, 0, j)),
+            spec(kp, bn, lambda p, i, j: (0, p, 0, j)),
         ],
-        out_specs=pl.BlockSpec(
-            (L, 1, mp, np_), lambda p: (0, p, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=out_shape,
+        out_specs=spec(bm, bn, lambda p, i, j: (0, p, i, j)),
+        out_shape=jax.ShapeDtypeStruct((L, 3, mp, np_), U32),
         interpret=_interpret(),
-    )(*ins)
-    out = out[:, :, :m, :n]
-    lo = out[0].astype(U64) | (out[1].astype(U64) << np.uint64(32))
-    if width == 64:
-        return lo, None
-    hi = out[2].astype(U64) | (out[3].astype(U64) << np.uint64(32))
-    return lo, hi
+    )
+
+    acc = None
+    for c0 in range(0, k, kseg):
+        c1 = min(c0 + kseg, k)
+        out = call(
+            slice_x(x0, c0, c1), slice_x(x1, c0, c1),
+            slice_y(y0, c0, c1), slice_y(ysum, c0, c1),
+        )[:, :, :m, :n]
+        lo = out[0].astype(U64) | (out[1].astype(U64) << np.uint64(32))
+        hi = (
+            None if width == 64
+            else out[2].astype(U64) | (out[3].astype(U64) << np.uint64(32))
+        )
+        acc = (lo, hi) if acc is None else ring.add(*acc, lo, hi)
+    return acc
 
 
 # ---------------------------------------------------------------------------
@@ -1235,7 +1312,15 @@ def _check_dot(width: int) -> None:
     from ..parallel import spmd
 
     rng = _check_rng()
-    for (m, k, n) in ((4, 37, 3), (2, 300, 5)):
+    # the last row forces a multi-tile grid (2 m-tiles x 2 n-tiles) AND
+    # host-side k segmentation (2 segments) on a small shape — the
+    # tiled/segmented code paths the MXU shapes exercise, checked at
+    # first-use cost
+    for (m, k, n, plan) in (
+        (4, 37, 3, None),
+        (2, 300, 5, None),
+        (10, 300, 130, (8, 128, 256)),
+    ):
         x0 = _rand_ring(rng, (3, m, k), width)
         x1 = _rand_ring(rng, (3, m, k), width)
         y0 = _rand_ring(rng, (3, k, n), width)
@@ -1247,7 +1332,9 @@ def _check_dot(width: int) -> None:
             return ring.add(*va, *vb)
 
         want = _jit_eval(want_fn)
-        got = _jit_eval(lambda: dot_cross_terms(x0, x1, y0, ys, width))
+        got = _jit_eval(
+            lambda: dot_cross_terms(x0, x1, y0, ys, width, tile_plan=plan)
+        )
         _assert_bitwise(got, want, f"dot_cross_terms({m},{k},{n})")
 
 

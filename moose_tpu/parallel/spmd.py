@@ -330,8 +330,12 @@ def _cross_terms(x: SpmdRep, y: SpmdRep, contract):
             # optimization; any failure keeps the exact XLA path
             _rk.record_fallback("cross_terms_mul", x.width, "error", e)
     ys_pair = None
+    dot_shape = (
+        (x0[0].shape[1], x0[0].shape[2], y0[0].shape[2])
+        if x0[0].ndim == 3 and y0[0].ndim == 3 else None
+    )
     if contract is _dot_contract and _rk.dispatch(
-        "dot_cross_terms", x.width
+        "dot_cross_terms", x.width, shape=dot_shape,
     ):
         ys_pair = ring.add(*y0, *y1)
         try:

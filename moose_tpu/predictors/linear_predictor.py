@@ -257,6 +257,19 @@ _ONNX_POST_TRANSFORMS = {
 }
 
 
+def _mirrored_pair(w: LinearWeights) -> bool:
+    """True when the two class rows are EXACT mirrors (bitwise: -w0 ==
+    w1, intercepts likewise) — the layout sklearn's binary
+    LinearClassifier ONNX export produces.  Near-mirrors stay on the
+    two-sigmoid path: the complement substitution is only claimed where
+    z0 = -z1 holds identically."""
+    if not np.array_equal(w.coeffs[0], -w.coeffs[1]):
+        return False
+    if w.intercepts is None:
+        return True
+    return np.array_equal(w.intercepts[:, 0], -w.intercepts[:, 1])
+
+
 class LinearClassifier(LinearPredictor):
     """Linear classifier predictor.
 
@@ -275,6 +288,16 @@ class LinearClassifier(LinearPredictor):
                 "Could not infer post-transform in LinearClassifier"
             )
         self._head = head_factory(self._weights.n_outputs)
+        # sklearn's binary LinearClassifier export bakes the two class
+        # rows as exact mirrors (-w, +w): the two logit columns are
+        # z and -z, so ONE protocol sigmoid suffices — the second
+        # bit-decompose/Goldschmidt ladder (the dominant cost of the
+        # traced binary graph) collapses to a subtraction
+        self._mirrored_binary = (
+            post_transform is PostTransform.SIGMOID
+            and self._weights.n_outputs == 2
+            and _mirrored_pair(self._weights)
+        )
 
     @classmethod
     def from_onnx(cls, model_proto):
@@ -299,6 +322,25 @@ class LinearClassifier(LinearPredictor):
             coeffs=coeffs, intercepts=intercepts,
             post_transform=post_transform,
         )
+
+    def __call__(self, x, fixedpoint_dtype=predictor_utils.DEFAULT_FIXED_DTYPE):
+        y = self.predictor_fn(x, fixedpoint_dtype)
+        if self._mirrored_binary:
+            return self._complement_sigmoid(y, fixedpoint_dtype)
+        return self.post_transform(y)
+
+    def _complement_sigmoid(self, y, fixedpoint_dtype):
+        """[1 - p, p] from one sigmoid of the positive-class logit —
+        exact for the real sigmoid (sigmoid(-z) = 1 - sigmoid(z)); for
+        the protocol approximation the complement column inherits the
+        positive column's approximation error instead of accruing its
+        own, which stays inside the sklearn-parity tolerance."""
+        pos = pm.sigmoid(pm.index_axis(y, axis=1, index=1))
+        pos = pm.expand_dims(pos, axis=1)
+        one = self.fixedpoint_constant(
+            1, plc=self.mirrored, dtype=fixedpoint_dtype
+        )
+        return pm.concatenate([pm.sub(one, pos), pos], axis=1)
 
     def post_transform(self, y):
         return self._head(y)

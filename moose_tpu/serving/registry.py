@@ -160,7 +160,26 @@ class ModelRegistry:
                 lint_check(comp)
             input_name = input_name or self._input_name(comp)
             if not buckets:
-                buckets = power_of_two_buckets(self.config.max_batch)
+                # autotuned default ladder: the full power-of-two set,
+                # minus buckets whose measured warmup latency (recorded
+                # by earlier registrations) is flat against the next
+                # bucket — padding is free there and each pruned bucket
+                # saves its warmup compiles.  An explicit buckets= stays
+                # the override.
+                from ..compilation import autotune as _autotune
+
+                bucket_dec = _autotune.serving_bucket_plan(
+                    self.config.max_batch
+                )
+                buckets = tuple(bucket_dec.choice)
+                root.attrs["buckets_source"] = bucket_dec.source
+                from .. import flight
+
+                flight.record(
+                    "serving_buckets_autotuned", model=name,
+                    buckets=[int(b) for b in buckets],
+                    source=bucket_dec.source, why=bucket_dec.why,
+                )
             buckets = tuple(sorted(set(int(b) for b in buckets)))
             if buckets[0] < 1:
                 # an explicit 0/negative bucket would warm a degenerate
@@ -390,16 +409,21 @@ class ModelRegistry:
         evaluations compare jit against eager bit-for-bit, and a
         degenerate all-zero operand would under-exercise the kernels
         being validated."""
+        import time as _time
+
         rng = np.random.default_rng(bucket)
         x = rng.normal(size=(bucket, *row_shape))
         with telemetry.span("warm_bucket", bucket=bucket) as sp:
             evals = 0
             plan_state = None
+            eval_s = None
             for _ in range(max(1, max_warmup_evals)):
                 with self.eval_lock:
+                    t0 = _time.perf_counter()
                     self.runtime.evaluate_computation(
                         comp, arguments={input_name: x}
                     )
+                    eval_s = _time.perf_counter() - t0
                     plan_state = getattr(
                         self.runtime, "last_plan", {}
                     ).get("plan_state")
@@ -408,6 +432,15 @@ class ModelRegistry:
                     break
             sp.attrs["evals"] = evals
             sp.attrs["plan_state"] = str(plan_state)
+        if eval_s is not None and plan_state != "validating":
+            # steady-state latency evidence for the bucket autotuner:
+            # later registrations prune buckets that measure flat
+            # against their next-larger neighbor
+            from ..compilation import autotune as _autotune
+
+            _autotune.measurements().record(
+                "bucket_latency", 0, str(bucket), eval_s=eval_s,
+            )
         if plan_state == "validating":
             from ..logger import get_logger
 

@@ -24,9 +24,12 @@ Threshold file (``benchmarks/bench_thresholds.json``)::
         "direction": "higher",          # higher|lower is better
         "max_regression_frac": 0.20,    # tolerated fractional slide
         "reference": "latest",          # latest|best over the trajectory
-        "required": false               # fail when the fresh record
-      },                                # lacks the metric (only once the
-      ...                               # trajectory has established it)
+        "required": false,              # fail when the fresh record
+                                        # lacks the metric (only once the
+                                        # trajectory has established it)
+        "floor": 69.0                   # absolute bound EVERY fresh
+      },                                # record must meet, regardless of
+      ...                               # how far the trajectory slid
     }
 
 Per metric: ``reference`` resolves against every committed BENCH round
@@ -34,9 +37,18 @@ Per metric: ``reference`` resolves against every committed BENCH round
 value ever recorded); the fresh value fails when it regresses past
 ``reference * (1 -/+ max_regression_frac)``.  Metrics the trajectory has
 never carried pass vacuously — the fresh record establishes their
-baseline.  ``--self-test`` proves the gate's own teeth: the merged
-latest trajectory record must PASS, and a synthetically regressed copy
-(every gated metric pushed to 2x its tolerated slide) must FAIL.
+baseline.
+
+``floor`` is the escape from ratchet decay: relative thresholds follow
+the trajectory down (69x -> 51x passed five rounds of "within 20% of
+latest"), a floor does not move.  Floors bind FRESH records only — they
+are the target the next committed round must clear, not a retroactive
+judgment of the trajectory (``--self-test`` evaluates the committed
+trajectory with floors disabled, then separately proves a below-floor
+record trips).  ``--self-test`` proves the gate's own teeth: the merged
+latest trajectory record must PASS, a synthetically regressed copy
+(every gated metric pushed to 2x its tolerated slide) must FAIL, and
+every floored metric must FAIL a record pushed just past its floor.
 """
 
 from __future__ import annotations
@@ -69,6 +81,9 @@ def load_thresholds(path) -> dict:
             raise ValueError(
                 f"{metric}: reference must be 'latest' or 'best'"
             )
+        floor = spec.get("floor")
+        if floor is not None and not isinstance(floor, (int, float)):
+            raise ValueError(f"{metric}: floor must be a number")
     return thresholds
 
 
@@ -148,9 +163,14 @@ def bound_for(spec: dict, reference: float) -> float:
     return reference * (1.0 + frac)
 
 
-def gate(record: dict, thresholds: dict, trajectory) -> dict:
+def gate(record: dict, thresholds: dict, trajectory,
+         enforce_floors: bool = True) -> dict:
     """Evaluate every thresholded metric; returns the machine-readable
-    verdict ({"ok": bool, "results": {metric: {...}}})."""
+    verdict ({"ok": bool, "results": {metric: {...}}}).
+
+    ``enforce_floors=False`` skips the absolute-floor checks — used by
+    ``--self-test`` when judging the committed trajectory, where floors
+    are forward-looking targets rather than retroactive failures."""
     results = {}
     ok = True
     for metric, spec in sorted(thresholds.items()):
@@ -162,6 +182,24 @@ def gate(record: dict, thresholds: dict, trajectory) -> dict:
             "reference_round": source,
             "fresh": fresh,
         }
+        floor = spec.get("floor")
+        if floor is not None:
+            entry["floor"] = floor
+        if (
+            enforce_floors
+            and floor is not None
+            and isinstance(fresh, (int, float))
+        ):
+            below = (
+                fresh < floor
+                if spec["direction"] == "higher"
+                else fresh > floor
+            )
+            if below:
+                entry["verdict"] = "FAIL(floor)"
+                ok = False
+                results[metric] = entry
+                continue
         if reference is None:
             # the trajectory never carried it: the fresh record (if it
             # has the metric) ESTABLISHES the baseline — by design a
@@ -209,6 +247,8 @@ def _print_verdict(verdict: dict, file=sys.stdout) -> None:
             parts.append(f"({entry['reference_round']})")
         if bound is not None:
             parts.append(f"bound={bound:.6g}")
+        if entry.get("floor") is not None:
+            parts.append(f"floor={entry['floor']:.6g}")
         print(" ".join(parts), file=file)
     print(
         ("BENCH GATE: PASS" if verdict["ok"] else "BENCH GATE: FAIL"),
@@ -217,20 +257,23 @@ def _print_verdict(verdict: dict, file=sys.stdout) -> None:
 
 
 def self_test(thresholds: dict, trajectory) -> int:
-    """The gate must pass the real trajectory and fail a synthetically
-    regressed copy of it — proof it has teeth, runnable in CI with no
-    fresh bench."""
+    """The gate must pass the real trajectory, fail a synthetically
+    regressed copy of it, and fail a below-floor record — proof it has
+    teeth, runnable in CI with no fresh bench."""
     if not trajectory:
         print("bench_gate --self-test: no BENCH_r*.json trajectory found")
         return 1
     # merged latest record: per metric, the newest round's value — the
-    # "real one" of the acceptance criterion
+    # "real one" of the acceptance criterion.  Floors are disabled for
+    # THIS check: a floor is the bar the next round must clear, and
+    # raising one above the current trajectory (e.g. vs_baseline back
+    # to the r03 69x) must not brick CI retroactively.
     merged: dict = {}
     for _, record in trajectory:
         for key, value in record.items():
             if isinstance(value, (int, float)):
                 merged[key] = value
-    verdict = gate(merged, thresholds, trajectory)
+    verdict = gate(merged, thresholds, trajectory, enforce_floors=False)
     if not verdict["ok"]:
         print("self-test FAILED: the real trajectory record was rejected")
         _print_verdict(verdict)
@@ -263,10 +306,31 @@ def self_test(thresholds: dict, trajectory) -> int:
         )
         _print_verdict(verdict_bad)
         return 1
+
+    # floor teeth: for every floored metric, a record sitting just past
+    # the floor (but otherwise healthy) must trip FAIL(floor)
+    floored = {
+        m: spec for m, spec in thresholds.items()
+        if spec.get("floor") is not None
+    }
+    floor_trips = 0
+    for metric, spec in floored.items():
+        probe = dict(merged)
+        nudge = 0.99 if spec["direction"] == "higher" else 1.01
+        probe[metric] = float(spec["floor"]) * nudge
+        entry = gate(probe, thresholds, trajectory)["results"][metric]
+        if entry["verdict"] != "FAIL(floor)":
+            print(
+                f"self-test FAILED: {metric} below its floor "
+                f"{spec['floor']} got verdict {entry['verdict']!r}"
+            )
+            return 1
+        floor_trips += 1
     print(json.dumps({
         "self_test": "ok",
         "gated_metrics": gated,
         "tripped_on_synthetic_regression": len(failed),
+        "floored_metrics": floor_trips,
         "passing_real_record_metrics": sorted(
             m for m, e in verdict["results"].items()
             if e["verdict"] == "pass"
